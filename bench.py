@@ -1,0 +1,75 @@
+"""Headline benchmark: GPT-2 124M pretrain step throughput (tokens/sec/chip).
+
+Mirrors BASELINE.json config 2 (GPT-2 124M LM pretrain) scaled to the single
+available chip; the flagship metric family is Train tokens/sec/chip.
+`published` in BASELINE.json is empty → vs_baseline is reported against our
+own first recorded value when available (BENCH_BASELINE.json), else 1.0.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    import optax
+
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+    from ray_tpu.train import spmd
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=-1, sp=1, tp=1))
+
+    cfg = gpt.GPTConfig.gpt2_124m(max_seq=1024, remat=True)
+    B, S = 8 * n_dev, 1024
+    optimizer = optax.adamw(3e-4, weight_decay=0.1)
+    params, opt_state, step = spmd.build_training(
+        cfg, mesh, optimizer, jax.random.key(0)
+    )
+
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    targets = jnp.roll(toks, -1, axis=1)
+
+    # Warmup / compile (donation means we must thread state through).
+    params, opt_state, loss = step(params, opt_state, (toks, targets))
+    float(loss)  # device->host transfer: drains the dispatch pipeline
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, (toks, targets))
+    float(loss)  # block_until_ready is not reliable on relayed backends
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * n_steps / dt
+    per_chip = tokens_per_sec / n_dev
+
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(base_path):
+        try:
+            base = json.load(open(base_path))["value"]
+            if base > 0:
+                vs = per_chip / base
+        except Exception:
+            pass
+
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Capability-parity rebuild of Ray (reference at /root/reference) designed
+TPU-first: JAX/XLA/pjit for compute, named device meshes + XLA collectives for
+distribution, Pallas for hot kernels, and a host-side distributed runtime
+(tasks / actors / objects) for orchestration.
+"""
+
+from ray_tpu._version import __version__
+
+_API_EXPORTS = (
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
+    "available_resources", "ObjectRef", "get_runtime_context",
+)
+
+
+def __getattr__(name):
+    if name in _API_EXPORTS:
+        from ray_tpu import api
+
+        return getattr(api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -1,0 +1,234 @@
+"""Functional GPT decoder, TPU-first.
+
+Flagship model family for the Train/Serve stacks (reference capability:
+GPT-2 124M pretrain and GPT-J 6B FSDP in Ray Train's release suites,
+`/root/reference/release/train_tests`). Design choices for TPU/XLA:
+
+- Pure-functional: params are a pytree; every entry is declared once in
+  `PARAM_SPECS` with shape + logical sharding axes, so the same table drives
+  init, sharding, and checkpointing.
+- Per-layer weights are **stacked on a leading `layers` axis and scanned**
+  (`jax.lax.scan`) — compile time is O(1) in depth and XLA still pipelines.
+- bfloat16 activations / fp32 params + fp32 layernorm and softmax.
+- Rotary position embeddings (GPT-J style, applied to the leading
+  `rotary_dim` of each head) — no position table to shard.
+- Attention heads shard over `tp`, mlp hidden over `tp`, params over `fsdp`
+  along `embed`, batch over `dp`+`fsdp` (see parallel/mesh.py rules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # GPT-2 BPE rounded up to a multiple of 128
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 1024
+    rotary_dim: int = 64             # per-head dims that get rotary; <= head_dim
+    dtype: Any = jnp.bfloat16        # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    tie_embeddings: bool = True
+    remat: bool = False              # jax.checkpoint each block (for big models)
+    attn_impl: str = "xla"           # "xla" | "flash" (pallas, TPU only)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def gpt2_124m(cls, **kw) -> "GPTConfig":
+        return cls(d_model=768, n_layers=12, n_heads=12, d_ff=3072, **kw)
+
+    @classmethod
+    def gpt2_350m(cls, **kw) -> "GPTConfig":
+        return cls(d_model=1024, n_layers=24, n_heads=16, d_ff=4096, **kw)
+
+    @classmethod
+    def gptj_6b(cls, **kw) -> "GPTConfig":
+        return cls(
+            d_model=4096, n_layers=28, n_heads=16, d_ff=16384,
+            rotary_dim=64, tie_embeddings=False, remat=True, **kw
+        )
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPTConfig":
+        """For tests / dryruns on CPU meshes."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq", 128)
+        kw.setdefault("rotary_dim", 4)
+        return cls(d_model=64, n_layers=2, n_heads=8, d_ff=128, **kw)
+
+
+def param_specs(cfg: GPTConfig) -> dict[str, dict[str, Any]]:
+    """name → {shape, axes (logical), init} — single source of truth.
+
+    Block params carry a leading `layers` axis (scanned).
+    """
+    D, H, K, F, L, V = (
+        cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
+        cfg.vocab_size,
+    )
+    norm = lambda *s: {"init": "normal", "scale": 0.02, "shape": s}
+    resid = lambda *s: {"init": "normal", "scale": 0.02 / math.sqrt(2 * L), "shape": s}
+    ones = lambda *s: {"init": "ones", "shape": s}
+    zeros = lambda *s: {"init": "zeros", "shape": s}
+
+    specs: dict[str, dict[str, Any]] = {
+        "wte": {**norm(V, D), "axes": ("vocab", "embed")},
+        "ln_f_scale": {**ones(D), "axes": ("embed",)},
+        "ln_f_bias": {**zeros(D), "axes": ("embed",)},
+        # Scanned block params:
+        "ln1_scale": {**ones(L, D), "axes": ("layers", "embed")},
+        "ln1_bias": {**zeros(L, D), "axes": ("layers", "embed")},
+        "wq": {**norm(L, D, H, K), "axes": ("layers", "embed", "heads", "kv")},
+        "wk": {**norm(L, D, H, K), "axes": ("layers", "embed", "heads", "kv")},
+        "wv": {**norm(L, D, H, K), "axes": ("layers", "embed", "heads", "kv")},
+        "wo": {**resid(L, H, K, D), "axes": ("layers", "heads", "kv", "embed")},
+        "ln2_scale": {**ones(L, D), "axes": ("layers", "embed")},
+        "ln2_bias": {**zeros(L, D), "axes": ("layers", "embed")},
+        "w_up": {**norm(L, D, F), "axes": ("layers", "embed", "mlp")},
+        "b_up": {**zeros(L, F), "axes": ("layers", "mlp")},
+        "w_down": {**resid(L, F, D), "axes": ("layers", "mlp", "embed")},
+        "b_down": {**zeros(L, D), "axes": ("layers", "embed")},
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = {**norm(D, V), "axes": ("embed", "vocab")}
+    return specs
+
+
+def logical_axes(cfg: GPTConfig) -> dict[str, tuple]:
+    return {k: v["axes"] for k, v in param_specs(cfg).items()}
+
+
+def init_params(cfg: GPTConfig, rng: jax.Array) -> dict[str, jax.Array]:
+    specs = param_specs(cfg)
+    keys = jax.random.split(rng, len(specs))
+    params = {}
+    for key, (name, spec) in zip(keys, sorted(specs.items())):
+        shape = spec["shape"]
+        if spec["init"] == "normal":
+            params[name] = (
+                jax.random.normal(key, shape, cfg.param_dtype) * spec["scale"]
+            )
+        elif spec["init"] == "ones":
+            params[name] = jnp.ones(shape, cfg.param_dtype)
+        else:
+            params[name] = jnp.zeros(shape, cfg.param_dtype)
+    return params
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _rotary(x: jax.Array, rotary_dim: int, offset: int = 0) -> jax.Array:
+    """Apply rotary embedding to x[..., S, H, K] over the first rotary_dim dims."""
+    S = x.shape[-3]
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, rotary_dim, 2) / rotary_dim))
+    pos = jnp.arange(offset, offset + S)[:, None] * inv_freq[None, :]  # [S, R/2]
+    sin = jnp.sin(pos)[:, None, :].astype(x.dtype)  # [S, 1, R/2]
+    cos = jnp.cos(pos)[:, None, :].astype(x.dtype)
+    x1, x2 = rot[..., 0::2], rot[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    rot = jnp.stack([out1, out2], axis=-1).reshape(rot.shape)
+    return jnp.concatenate([rot, rest], axis=-1)
+
+
+def _attention(q, k, v, cfg: GPTConfig, *, causal_offset: int = 0):
+    """q,k,v: [B, S, H, K] (q) / [B, T, H, K] (k,v). fp32 logits+softmax."""
+    if cfg.attn_impl == "flash":
+        from ray_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    S, T = q.shape[-3], k.shape[-3]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum(
+        "bshk,bthk->bhst", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    qpos = jnp.arange(S)[:, None] + causal_offset
+    kpos = jnp.arange(T)[None, :]
+    mask = qpos >= kpos
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthk->bshk", probs, v)
+
+
+def _block(x: jax.Array, layer: dict[str, jax.Array], cfg: GPTConfig) -> jax.Array:
+    """One pre-norm transformer block. x: [B, S, D]."""
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = _rotary(q, cfg.rotary_dim)
+    k = _rotary(k, cfg.rotary_dim)
+    attn = _attention(q, k, v, cfg)
+    attn_out = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(cfg.dtype))
+    x = x + attn_out
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+    up = up + layer["b_up"].astype(cfg.dtype)
+    up = jax.nn.gelu(up)
+    down = jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(cfg.dtype))
+    down = down + layer["b_down"].astype(cfg.dtype)
+    return x + down
+
+
+_BLOCK_KEYS = (
+    "ln1_scale", "ln1_bias", "wq", "wk", "wv", "wo",
+    "ln2_scale", "ln2_bias", "w_up", "b_up", "w_down", "b_down",
+)
+
+
+def forward(params: dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
+    """tokens: [B, S] int32 → logits [B, S, V] (cfg.dtype)."""
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+
+    def body(x, layer):
+        fn = _block
+        if cfg.remat:
+            fn = jax.checkpoint(_block, static_argnums=(2,))
+        return fn(x, layer, cfg), None
+
+    x, _ = jax.lax.scan(body, x, stacked)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    head = params["lm_head"] if not cfg.tie_embeddings else params["wte"].T
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, head.astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def loss_fn(
+    params: dict[str, jax.Array],
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: GPTConfig,
+) -> jax.Array:
+    """Mean next-token cross-entropy. tokens/targets: [B, S] int32."""
+    logits = forward(params, tokens, cfg)  # fp32
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def num_params(cfg: GPTConfig) -> int:
+    return sum(math.prod(s["shape"]) for s in param_specs(cfg).values())

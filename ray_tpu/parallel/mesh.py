@@ -1,0 +1,122 @@
+"""Device-mesh construction for TPU-native SPMD.
+
+This replaces the reference's NCCL/Gloo process-group bootstrap
+(`python/ray/util/collective/collective.py`, `python/ray/train/torch/config.py:120-174`
+in /root/reference) with JAX named meshes: parallelism axes are declared once,
+shardings are expressed as `PartitionSpec`s over axis names, and XLA inserts the
+ICI/DCN collectives.
+
+Axis convention (order matters — outermost axis maps to the slowest-varying
+device dimension, which on multi-host TPU should be the DCN dimension):
+
+    ("dp", "fsdp", "sp", "tp")
+
+- dp:   pure data parallelism (gradient all-reduce; rides DCN across slices)
+- fsdp: data parallelism with sharded parameters/optimizer (ZeRO-3 style;
+        all-gather weights / reduce-scatter grads over ICI)
+- sp:   sequence/context parallelism (ring attention sends KV blocks over ICI)
+- tp:   tensor (megatron-style) parallelism; innermost so its collectives ride
+        the fastest ICI loops
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis order, outermost (slowest / DCN) first.
+MESH_AXES: tuple[str, ...] = ("dp", "fsdp", "sp", "tp")
+
+# Logical model axes → mesh axes. Anything not listed is replicated.
+# This is the single source of truth used by sharding.logical_to_spec.
+DEFAULT_LOGICAL_RULES: tuple[tuple[str, Any], ...] = (
+    ("batch", ("dp", "fsdp")),   # batch sharded over both data axes
+    ("seq", "sp"),               # sequence/context parallelism
+    ("embed", "fsdp"),           # ZeRO-3: shard params along embed over fsdp
+    ("mlp", "tp"),               # megatron: shard mlp hidden over tp
+    ("heads", "tp"),             # megatron: shard attention heads over tp
+    ("kv", None),
+    ("vocab", "tp"),
+    ("layers", None),            # stacked-layer leading axis (scanned)
+    ("expert", "tp"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape. -1 on at most one axis means "use the rest"."""
+
+    dp: int = 1
+    fsdp: int = -1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} does not cover {n_devices} devices"
+            )
+        return sizes
+
+
+def make_mesh(
+    config: MeshConfig | dict[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named Mesh over `devices` (default: all global devices).
+
+    Uses jax.experimental.mesh_utils device ordering when possible so the
+    innermost axes land on ICI-adjacent chips.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if config is None:
+        config = MeshConfig(dp=1, fsdp=-1, sp=1, tp=1)
+    if isinstance(config, MeshConfig):
+        sizes = config.resolve(n)
+    else:
+        sizes = dict(config)
+        for ax in MESH_AXES:
+            sizes.setdefault(ax, 1)
+        sizes = MeshConfig(**{k: sizes[k] for k in MESH_AXES}).resolve(n)
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except Exception:
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    if device is None:
+        device = jax.devices()[0]
+    return make_mesh(MeshConfig(dp=1, fsdp=1, sp=1, tp=1), devices=[device])
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [batch, ...] host data: batch split over dp+fsdp."""
+    return NamedSharding(mesh, PartitionSpec(("dp", "fsdp")))

@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's multi-node-without-a-cluster trick
+(`/root/reference/python/ray/cluster_utils.py:99` — N raylets on one machine):
+here, N XLA host devices on one process stand in for N TPU chips so every
+sharding/collective path is exercised without a pod.
+
+Must run before any backend is initialized: XLA_FLAGS is read at backend
+creation, and the axon sitecustomize pins jax_platforms to "axon,cpu", so we
+override the config directly rather than via JAX_PLATFORMS.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
