@@ -1,0 +1,453 @@
+"""Public API: init/remote/get/put/wait/kill/cancel + handles.
+
+Parity with the reference's Python surface (`/root/reference/python/ray/
+__init__.py:204` __all__, `remote_function.py:35` RemoteFunction,
+`actor.py:377,1020` ActorClass/ActorHandle, `_private/worker.py:2241,2334`
+get/put). Option validation mirrors `_private/ray_option_utils.py`.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import logging
+import os
+import threading
+from typing import Any, Sequence
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, ObjectID
+
+logger = logging.getLogger(__name__)
+
+_client = None
+_node = None
+_lock = threading.RLock()
+
+
+class RayTaskError(Exception):
+    """A task/actor method raised; carries the remote traceback."""
+
+    def __init__(self, exc_type: str, message: str, tb: str):
+        self.exc_type = exc_type
+        self.remote_traceback = tb
+        super().__init__(f"{exc_type}: {message}\n--- remote traceback ---\n{tb}")
+
+
+class ObjectRef:
+    """Future-like handle to an object in the cluster.
+
+    Pickles by identity (ref: `_private/serialization.py:110-131`) so refs can
+    be captured in closures and passed into tasks.
+    """
+
+    __slots__ = ("id",)
+
+    def __init__(self, object_id: ObjectID):
+        self.id = object_id
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id,))
+
+    def future(self):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(get(self))
+            except Exception as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+
+# --------------------------------------------------------------- init
+
+def is_initialized() -> bool:
+    return _client is not None
+
+
+def _ensure_client():
+    """Lazy-attach inside worker processes (env set by core/worker.py)."""
+    global _client
+    with _lock:
+        if _client is None:
+            raylet = os.environ.get("RAY_TPU_RAYLET_ADDRESS")
+            gcs = os.environ.get("RAY_TPU_GCS_ADDRESS")
+            if raylet and gcs:
+                init(address=gcs, _raylet_address=raylet)
+            else:
+                init()
+        return _client
+
+
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: int | None = None,
+    resources: dict[str, float] | None = None,
+    object_store_memory: int | None = None,
+    _system_config: dict | None = None,
+    _raylet_address: str | None = None,
+    ignore_reinit_error: bool = False,
+):
+    """Start (or attach to) a cluster.
+
+    - address=None: start a single-node local cluster (GCS + raylet
+      subprocesses), like the reference's `ray.init()` auto-start
+      (`_private/worker.py:1031`).
+    - address="host:port": attach to an existing GCS.
+    """
+    global _client, _node
+    with _lock:
+        if _client is not None:
+            if ignore_reinit_error:
+                return _client
+            raise RuntimeError("ray_tpu already initialized")
+        from ray_tpu.core.client import CoreClient
+
+        config = Config.from_env().override(_system_config)
+        if object_store_memory is not None:
+            config.object_store_memory = object_store_memory
+        if address is None:
+            from ray_tpu.core.node import Node
+
+            res = dict(resources or {})
+            if num_cpus is not None:
+                res["CPU"] = num_cpus
+            res.setdefault("CPU", os.cpu_count() or 1)
+            _node = Node(config, head=True, resources=res)
+            _node.start()
+            gcs_addr = _node.gcs_address
+            raylet_addr = _node.raylet_address
+            atexit.register(shutdown)
+        else:
+            host, port = address.rsplit(":", 1)
+            gcs_addr = (host, int(port))
+            if _raylet_address is not None:
+                rh, rp = _raylet_address.rsplit(":", 1)
+                raylet_addr = (rh, int(rp))
+            else:
+                raylet_addr = _pick_raylet(gcs_addr, config)
+        _client = CoreClient(gcs_addr, raylet_addr, config)
+        return _client
+
+
+def _pick_raylet(gcs_addr, config) -> tuple[str, int]:
+    """Drivers attaching remotely use the least-loaded alive raylet."""
+    import asyncio
+
+    from ray_tpu.core import rpc
+
+    async def go():
+        conn = await rpc.connect(*gcs_addr, timeout=config.rpc_connect_timeout_s)
+        view = await conn.call("get_cluster_view", {})
+        await conn.close()
+        alive = [n for n in view.values() if n.get("alive", True)]
+        if not alive:
+            raise RuntimeError("no alive nodes in cluster")
+        best = min(alive, key=lambda n: n.get("load", 0))
+        return tuple(best["address"])
+
+    return asyncio.run(go())
+
+
+def shutdown() -> None:
+    global _client, _node
+    with _lock:
+        if _client is not None:
+            _client.shutdown()
+            _client = None
+        if _node is not None:
+            _node.stop()
+            _node = None
+
+
+# --------------------------------------------------------------- options
+
+_TASK_ONLY = {"num_returns", "max_retries"}
+_ACTOR_ONLY = {"max_restarts", "max_concurrency", "name", "get_if_exists",
+               "lifetime", "max_task_retries"}
+_COMMON = {"num_cpus", "num_tpus", "resources", "scheduling_strategy",
+           "runtime_env", "placement_group"}
+
+
+def _build_resources(opts: dict) -> dict[str, float]:
+    res = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    elif "CPU" not in res:
+        res["CPU"] = 1.0
+    if opts.get("num_tpus") is not None:
+        res["TPU"] = float(opts["num_tpus"])
+    return res
+
+
+def _validate_options(opts: dict, *, for_actor: bool) -> None:
+    allowed = _COMMON | (_ACTOR_ONLY if for_actor else _TASK_ONLY)
+    unknown = set(opts) - allowed
+    if unknown:
+        kind = "actor" if for_actor else "task"
+        raise ValueError(f"invalid {kind} options: {sorted(unknown)}")
+
+
+class RemoteFunction:
+    """Handle produced by @remote on a function
+    (ref: remote_function.py:35)."""
+
+    def __init__(self, fn, options: dict):
+        _validate_options(options, for_actor=False)
+        self._fn = fn
+        self._options = options
+        self._fn_blob: bytes | None = None
+        functools.update_wrapper(self, fn)
+
+    def _blob(self) -> bytes:
+        if self._fn_blob is None:
+            self._fn_blob = serialization.pack(self._fn)
+        return self._fn_blob
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = {**self._options, **opts}
+        return RemoteFunction(self._fn, merged)
+
+    def remote(self, *args, **kwargs):
+        client = _ensure_client()
+        o = self._options
+        refs = client.submit_task(
+            self._blob(),
+            getattr(self._fn, "__name__", "task"),
+            args, kwargs,
+            num_returns=o.get("num_returns", 1),
+            resources=_build_resources(o),
+            max_retries=o.get("max_retries"),
+            scheduling_strategy=_strategy_payload(o),
+        )
+        return refs[0] if o.get("num_returns", 1) == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "Remote function cannot be called directly; use .remote()"
+        )
+
+
+def _strategy_payload(o: dict):
+    s = o.get("scheduling_strategy")
+    pg = o.get("placement_group")
+    if pg is not None:
+        from ray_tpu.core.placement_group import PlacementGroup
+
+        if isinstance(pg, PlacementGroup):
+            return {"type": "placement_group", "pg_id": pg.id.binary(),
+                    "bundle_index": o.get("placement_group_bundle_index", -1)}
+    if s is None or isinstance(s, str):
+        return s
+    # NodeAffinitySchedulingStrategy-like object
+    if hasattr(s, "node_id"):
+        return {"type": "node_affinity", "node_id": s.node_id,
+                "soft": getattr(s, "soft", False)}
+    return None
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        client = _ensure_client()
+        refs = client.submit_actor_task(
+            self._handle._actor_id.binary(),
+            self._name, args, kwargs,
+            num_returns=self._num_returns,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    """Callable handle to a live actor (ref: actor.py:1020)."""
+
+    def __init__(self, actor_id: ActorID):
+        self._actor_id = actor_id
+
+    def __getattr__(self, item: str) -> ActorMethod:
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id,))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+
+class ActorClass:
+    """Handle produced by @remote on a class (ref: actor.py:377)."""
+
+    def __init__(self, cls, options: dict):
+        _validate_options(options, for_actor=True)
+        self._cls = cls
+        self._options = options
+
+    def options(self, **opts) -> "ActorClass":
+        return ActorClass(self._cls, {**self._options, **opts})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        client = _ensure_client()
+        o = self._options
+        actor_id = client.create_actor(
+            serialization.pack(self._cls),
+            self._cls.__name__,
+            args, kwargs,
+            resources=_build_resources(o),
+            max_restarts=o.get("max_restarts", 0),
+            max_concurrency=o.get("max_concurrency", 1),
+            actor_name=o.get("name"),
+            get_if_exists=o.get("get_if_exists", False),
+        )
+        return ActorHandle(ActorID(actor_id))
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("Actor class cannot be instantiated directly; "
+                        "use .remote()")
+
+
+def remote(*args, **options):
+    """@remote decorator for tasks and actors (ref: worker.py `ray.remote`)."""
+    if len(args) == 1 and callable(args[0]) and not options:
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target, {})
+        return RemoteFunction(target, {})
+    if args:
+        raise TypeError("use @remote or @remote(**options)")
+
+    def deco(target):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    return deco
+
+
+# --------------------------------------------------------------- data plane
+
+def put(value: Any) -> ObjectRef:
+    return _ensure_client().put(value)
+
+
+def get(refs, timeout: float | None = None):
+    client = _ensure_client()
+    single = isinstance(refs, ObjectRef)
+    if single:
+        refs = [refs]
+    if not all(isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("get() accepts an ObjectRef or a list of ObjectRefs")
+    out = client.get(refs, timeout)
+    return out[0] if single else out
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: float | None = None):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() accepts a list of ObjectRefs")
+    return _ensure_client().wait(refs, num_returns, timeout)
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    _ensure_client().free(refs)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _ensure_client().kill_actor(actor._actor_id.binary(), no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    # v1: cooperative cancel not yet implemented; reserved API surface.
+    logger.warning("cancel() is best-effort and not yet implemented")
+
+
+def get_actor(name: str) -> ActorHandle:
+    actor_id = _ensure_client().get_named_actor(name)
+    if actor_id is None:
+        raise ValueError(f"no alive actor named {name!r}")
+    return ActorHandle(ActorID(actor_id))
+
+
+# --------------------------------------------------------------- cluster info
+
+def nodes() -> list[dict]:
+    view = _ensure_client().cluster_view()
+    return [
+        {"NodeID": nid.hex(), "Alive": n["alive"],
+         "Resources": n["resources_total"], "Address": n["address"],
+         "Labels": n.get("labels", {})}
+        for nid, n in view.items()
+    ]
+
+
+def cluster_resources() -> dict[str, float]:
+    total: dict[str, float] = {}
+    for n in _ensure_client().cluster_view().values():
+        if not n.get("alive", True):
+            continue
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def available_resources() -> dict[str, float]:
+    total: dict[str, float] = {}
+    for n in _ensure_client().cluster_view().values():
+        if not n.get("alive", True):
+            continue
+        for k, v in n["resources_available"].items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+class RuntimeContext:
+    @property
+    def job_id(self):
+        return _ensure_client().job_id
+
+    @property
+    def is_initialized(self):
+        return is_initialized()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()
+
+
+def method(**opts):
+    """Decorator for actor methods (num_returns), parity with ray.method."""
+
+    def deco(fn):
+        fn.__ray_tpu_method_opts__ = opts
+        return fn
+
+    return deco

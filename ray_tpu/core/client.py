@@ -1,0 +1,749 @@
+"""CoreClient: the submit-side runtime embedded in drivers and workers.
+
+Parity with the reference's CoreWorker submit path (`/root/reference/src/ray/
+core_worker/core_worker.cc` SubmitTask/CreateActor/SubmitActorTask +
+`direct_task_transport.cc`): lease-based scheduling with spillback, direct
+push to leased workers, per-actor ordered pipelines, retries on worker death,
+and object put/get/wait against the node store.
+
+Threading: one background asyncio loop; the public API is synchronous and
+thread-safe (calls are marshalled with run_coroutine_threadsafe).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import os
+import threading
+import time
+from typing import Any, Sequence
+
+from ray_tpu.core import rpc, serialization
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store import attach_segment
+from ray_tpu.core.task_spec import (
+    ACTOR_CREATION,
+    ACTOR_TASK,
+    NORMAL_TASK,
+    ArgSpec,
+    TaskSpec,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class GetTimeoutError(TimeoutError):
+    pass
+
+
+class ActorDiedError(RuntimeError):
+    pass
+
+
+class ActorState:
+    def __init__(self, actor_id: bytes):
+        self.actor_id = actor_id
+        self.address: tuple[str, int] | None = None
+        self.conn: rpc.Connection | None = None
+        self.seq = itertools.count()
+        self.dead = False
+        self.death_cause: str | None = None
+        self.resources: dict[str, float] = {}
+        self.ready = asyncio.Event()   # set when ALIVE (or DEAD — check .dead)
+        self.restarting = False
+
+
+class CoreClient:
+    def __init__(
+        self,
+        gcs_address: tuple[str, int],
+        raylet_address: tuple[str, int],
+        config: Config | None = None,
+        job_id: bytes | None = None,
+    ):
+        self.config = config or Config.from_env()
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="ray_tpu-client", daemon=True
+        )
+        self._thread.start()
+        self.gcs: rpc.Connection = self._run(self._connect(gcs_address))
+        self.raylet: rpc.Connection = self._run(self._connect(raylet_address))
+        if job_id is None:
+            job_id = self._run(self.gcs.call("next_job_id", {}))
+        self.job_id = job_id
+        self.task_id_root = TaskID.for_task(JobID(job_id))
+        self._put_counter = itertools.count(1)
+        self._memory_store: dict[bytes, Any] = {}
+        self._mmaps: dict[bytes, memoryview] = {}
+        self._actors: dict[bytes, ActorState] = {}
+        self._worker_conns: dict[tuple[str, int], rpc.Connection] = {}
+        self._raylet_conns: dict[tuple[str, int], rpc.Connection] = {}
+        self._result_events: dict[bytes, threading.Event] = {}
+        self._closed = False
+        self._run(self.gcs.call("subscribe", {"channels": ["actor"]}))
+
+    # ------------------------------------------------------------ plumbing
+
+    async def _connect(self, addr) -> rpc.Connection:
+        return await rpc.connect(
+            *addr,
+            timeout=self.config.rpc_connect_timeout_s,
+            notify_handler=self._notify,
+        )
+
+    def _notify(self, method: str, payload: Any) -> None:
+        if method == "pub:actor":
+            st = self._actors.get(payload["actor_id"])
+            if st is None:
+                return
+            state = payload.get("state")
+            if state == "ALIVE":
+                st.address = tuple(payload["address"])
+                st.restarting = False
+                st.ready.set()
+            elif state == "RESTARTING":
+                st.restarting = True
+                st.address = None
+                st.conn = None
+                st.ready.clear()
+            elif state == "DEAD":
+                st.dead = True
+                st.death_cause = payload.get("cause")
+                st.ready.set()
+
+    def _run(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for mv in self._mmaps.values():
+            try:
+                mv.release()
+            except BufferError:
+                pass
+        async def _close_all():
+            conns = [self.gcs, self.raylet,
+                     *self._worker_conns.values(),
+                     *self._raylet_conns.values()]
+            for c in conns:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+
+        try:
+            self._run(_close_all(), timeout=3)
+        except Exception:
+            pass
+        try:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=2)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ objects
+
+    def put(self, value: Any):
+        from ray_tpu.api import ObjectRef
+
+        obj = ObjectID.from_put(self.task_id_root, next(self._put_counter))
+        head, views = serialization.serialize(value)
+        size = serialization.serialized_size(head, views)
+        if size <= self.config.max_inline_object_size:
+            data = bytearray(size)
+            serialization.write_to(memoryview(data), head, views)
+            self._run(self.raylet.call("store_put_inline", {
+                "object_id": obj.binary(), "data": bytes(data),
+            }))
+        else:
+            resp = self._run(self.raylet.call("store_create", {
+                "object_id": obj.binary(), "size": size,
+            }))
+            view = attach_segment(resp["shm_name"], size)
+            serialization.write_to(view, head, views)
+            view.release()
+            self._run(self.raylet.call("store_seal", {"object_id": obj.binary()}))
+        self._memory_store[obj.binary()] = value
+        return ObjectRef(obj)
+
+    def get(self, refs: Sequence, timeout: float | None = None) -> list:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # First wait for any of our own in-flight tasks to land (their error
+        # results only exist in the in-process store, never in the node store).
+        for ref in refs:
+            ev = self._result_events.get(ref.id.binary())
+            if ev is not None:
+                remaining = (
+                    None if deadline is None else max(0, deadline - time.monotonic())
+                )
+                if not ev.wait(remaining):
+                    raise GetTimeoutError(
+                        f"task for object {ref.id.hex()[:16]} not finished in time"
+                    )
+        out: list[Any] = [None] * len(refs)
+        missing: list[tuple[int, bytes]] = []
+        for i, ref in enumerate(refs):
+            key = ref.id.binary()
+            if key in self._memory_store:
+                out[i] = self._memory_store[key]
+            else:
+                missing.append((i, key))
+        if missing:
+            resolved = self._run(self.raylet.call("store_get", {
+                "object_ids": [k for _, k in missing],
+                "timeout": timeout,
+            }), timeout=None if timeout is None else timeout + 10)
+            for (i, key), (loc, data) in zip(missing, resolved):
+                if loc == "missing":
+                    raise GetTimeoutError(
+                        f"object {key.hex()[:16]} not available within timeout"
+                    )
+                if loc == "inline":
+                    value = serialization.unpack(data)
+                else:
+                    name, size = data
+                    view = attach_segment(name, size)
+                    self._mmaps[key] = view
+                    value = serialization.unpack(view)
+                self._memory_store[key] = value
+                out[i] = value
+        for i, ref in enumerate(refs):
+            if isinstance(out[i], _TaskErrorSentinel):
+                raise out[i].err.to_exception()
+            from ray_tpu.core.task_error import TaskError
+
+            if isinstance(out[i], TaskError):
+                raise out[i].to_exception()
+        return out
+
+    def wait(
+        self,
+        refs: Sequence,
+        num_returns: int = 1,
+        timeout: float | None = None,
+    ) -> tuple[list, list]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: list = []
+        while True:
+            still = []
+            keys = [r.id.binary() for r in pending]
+            in_mem = [k in self._memory_store for k in keys]
+            to_check = [k for k, m in zip(keys, in_mem) if not m]
+            if to_check:
+                present = self._run(self.raylet.call("store_contains", {
+                    "object_ids": to_check,
+                }))
+                present_map = dict(zip(to_check, present))
+            else:
+                present_map = {}
+            for r, k, m in zip(pending, keys, in_mem):
+                if m or present_map.get(k):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    def free(self, refs: Sequence) -> None:
+        keys = [r.id.binary() for r in refs]
+        for k in keys:
+            self._memory_store.pop(k, None)
+            mv = self._mmaps.pop(k, None)
+            if mv is not None:
+                try:
+                    mv.release()
+                except BufferError:
+                    pass
+        self._run(self.gcs.call("obj_free", {"object_ids": keys}))
+        self._run(self.raylet.call("store_free", {"object_ids": keys}))
+
+    # ------------------------------------------------------------ tasks
+
+    def _build_args(self, args: tuple, kwargs: dict) -> tuple[list[ArgSpec], list[str]]:
+        from ray_tpu.api import ObjectRef
+
+        specs: list[ArgSpec] = []
+        flat = list(args) + list(kwargs.values())
+        for a in flat:
+            if isinstance(a, ObjectRef):
+                specs.append(ArgSpec(kind="ref", object_id=a.id.binary()))
+            else:
+                head, views = serialization.serialize(a)
+                size = serialization.serialized_size(head, views)
+                if size > self.config.max_inline_object_size:
+                    ref = self.put(a)
+                    specs.append(ArgSpec(kind="ref", object_id=ref.id.binary()))
+                else:
+                    data = bytearray(size)
+                    serialization.write_to(memoryview(data), head, views)
+                    specs.append(ArgSpec(kind="value", value=bytes(data)))
+        return specs, list(kwargs.keys())
+
+    def submit_task(
+        self,
+        fn_blob: bytes,
+        name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        resources: dict[str, float] | None = None,
+        max_retries: int | None = None,
+        scheduling_strategy: Any = None,
+    ) -> list:
+        from ray_tpu.api import ObjectRef
+
+        task_id = TaskID.for_task(JobID(self.job_id))
+        arg_specs, kw_keys = self._build_args(args, kwargs)
+        n = max(num_returns, 0)
+        return_ids = [
+            ObjectID.for_return(task_id, i).binary() for i in range(max(n, 1))
+        ]
+        spec = TaskSpec(
+            kind=NORMAL_TASK,
+            task_id=task_id.binary(),
+            job_id=self.job_id,
+            name=name,
+            fn_blob=fn_blob,
+            args=arg_specs,
+            kwargs_keys=kw_keys,
+            num_returns=n,
+            return_ids=return_ids,
+            resources=resources or {"CPU": 1},
+            max_retries=(
+                self.config.default_max_retries
+                if max_retries is None else max_retries
+            ),
+            scheduling_strategy=scheduling_strategy,
+        )
+        for rid in return_ids:
+            ev = threading.Event()
+            self._result_events[rid] = ev
+        asyncio.run_coroutine_threadsafe(self._drive_task(spec), self._loop)
+        refs = [ObjectRef(ObjectID(rid)) for rid in return_ids[:max(n, 1)]]
+        return refs if n != 1 else refs[:1]
+
+    async def _lease_worker(self, spec: TaskSpec) -> tuple[dict, rpc.Connection]:
+        """Lease a worker, following spillback redirects
+        (ref: direct_task_transport.cc:325 RequestNewWorkerIfNeeded)."""
+        raylet = self.raylet
+        raylet_addr = self.raylet_address
+        for _hop in range(8):
+            grant = await raylet.call("request_lease", {
+                "resources": spec.resources,
+                "strategy": spec.scheduling_strategy,
+                "timeout": self.config.lease_timeout_s,
+            }, timeout=self.config.lease_timeout_s + 10)
+            if "spillback" in grant:
+                raylet_addr = tuple(grant["spillback"])
+                raylet = await self._raylet_conn(raylet_addr)
+                continue
+            if "error" in grant:
+                raise RuntimeError(f"lease failed: {grant['error']}")
+            return grant, raylet
+        raise RuntimeError("spillback loop exceeded 8 hops")
+
+    async def _raylet_conn(self, addr: tuple[str, int]) -> rpc.Connection:
+        if addr == self.raylet_address:
+            return self.raylet
+        conn = self._raylet_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*addr, timeout=self.config.rpc_connect_timeout_s)
+            self._raylet_conns[addr] = conn
+        return conn
+
+    async def _worker_conn(self, addr: tuple[str, int]) -> rpc.Connection:
+        conn = self._worker_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*addr, timeout=self.config.rpc_connect_timeout_s)
+            self._worker_conns[addr] = conn
+        return conn
+
+    async def _drive_task(self, spec: TaskSpec) -> None:
+        """Lease → push → collect returns, with retries on worker death
+        (ref: task_manager.h:86 retry bookkeeping)."""
+        from ray_tpu.core.task_error import TaskError
+
+        attempts = spec.max_retries + 1
+        last_err: Any = None
+        for attempt in range(attempts):
+            spec.retry_count = attempt
+            try:
+                grant, lessor = await self._lease_worker(spec)
+            except Exception as e:
+                last_err = TaskError("SchedulingError", str(e), "")
+                break
+            worker_addr = tuple(grant["worker_address"])
+            worker_id = grant["worker_id"]
+            try:
+                conn = await self._worker_conn(worker_addr)
+                reply = await conn.call("push_task", {"spec": spec})
+                await lessor.call("release_lease", {"worker_id": worker_id})
+                self._record_returns(spec, reply)
+                return
+            except (rpc.ConnectionLost, rpc.RpcError) as e:
+                await self._safe_release(lessor, worker_id, dead=True)
+                last_err = TaskError(
+                    "WorkerCrashedError",
+                    f"worker died executing {spec.name}: {e}", "",
+                )
+                logger.warning("task %s attempt %d failed: %s",
+                               spec.name, attempt, e)
+                continue
+        self._fail_returns(spec, last_err)
+
+    async def _safe_release(self, lessor, worker_id, dead=False):
+        try:
+            await lessor.call("release_lease", {
+                "worker_id": worker_id, "dead": dead,
+            }, timeout=5)
+        except Exception:
+            pass
+
+    def _record_returns(self, spec: TaskSpec, reply: dict) -> None:
+        for rid, (loc, data) in zip(spec.return_ids, reply["returns"]):
+            if loc == "inline":
+                value = serialization.unpack(data)
+                self._memory_store[rid] = value
+            ev = self._result_events.pop(rid, None)
+            if ev is not None:
+                ev.set()
+
+    def _fail_returns(self, spec: TaskSpec, err) -> None:
+        from ray_tpu.core.task_error import TaskError
+
+        if err is None:
+            err = TaskError("UnknownError", "task failed", "")
+        for rid in spec.return_ids:
+            self._memory_store[rid] = err
+            ev = self._result_events.pop(rid, None)
+            if ev is not None:
+                ev.set()
+
+    # ------------------------------------------------------------ actors
+
+    def create_actor(
+        self,
+        cls_blob: bytes,
+        name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        resources: dict[str, float] | None = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        actor_name: str | None = None,
+        get_if_exists: bool = False,
+    ) -> bytes:
+        actor_id = ActorID.of(JobID(self.job_id)).binary()
+        resources = resources or {"CPU": 1}
+        st = ActorState(actor_id)
+        st.resources = resources
+        self._actors[actor_id] = st
+        result = self._run(self._create_actor_async(
+            st, cls_blob, name, args, kwargs, resources,
+            max_restarts, max_concurrency, actor_name, get_if_exists,
+        ))
+        if isinstance(result, bytes):       # got existing named actor
+            return result
+        return actor_id
+
+    async def _create_actor_async(
+        self, st, cls_blob, name, args, kwargs, resources,
+        max_restarts, max_concurrency, actor_name, get_if_exists,
+    ):
+        task_id = TaskID.for_actor_task(ActorID(st.actor_id))
+        arg_specs, kw_keys = self._build_args(args, kwargs)
+        spec = TaskSpec(
+            kind=ACTOR_CREATION,
+            task_id=task_id.binary(),
+            job_id=self.job_id,
+            name=f"{name}.__init__",
+            fn_blob=cls_blob,
+            args=arg_specs,
+            kwargs_keys=kw_keys,
+            num_returns=1,
+            return_ids=[ObjectID.for_return(task_id, 0).binary()],
+            resources=resources,
+            actor_id=st.actor_id,
+            max_restarts=max_restarts,
+            max_concurrency=max_concurrency,
+            actor_name=actor_name,
+        )
+        reg = await self.gcs.call("register_actor", {
+            "actor_id": st.actor_id,
+            "name": actor_name,
+            "max_restarts": max_restarts,
+            "resources": resources,
+            "create_spec": serialization.dumps_call(spec),
+        })
+        if not reg.get("ok"):
+            if get_if_exists and actor_name:
+                info = await self.gcs.call("get_actor", {"name": actor_name})
+                if info is not None:
+                    existing = ActorState(info["actor_id"])
+                    existing.address = (
+                        tuple(info["address"]) if info["address"] else None
+                    )
+                    if existing.address:
+                        existing.ready.set()
+                    self._actors[info["actor_id"]] = existing
+                    return info["actor_id"]
+            raise RuntimeError(reg.get("error", "actor registration failed"))
+        asyncio.ensure_future(self._place_actor(
+            st, spec, tuple(reg["node_address"]), reg["node_id"]
+        ))
+        return None
+
+    async def _place_actor(self, st: ActorState, spec: TaskSpec,
+                           node_address: tuple[str, int],
+                           node_id: bytes = b"") -> None:
+        """Lease a worker on the chosen node and run the creation task
+        (ref: gcs_actor_scheduler.cc ScheduleByRaylet)."""
+        try:
+            raylet = await self._raylet_conn(node_address)
+            grant = await raylet.call("request_lease", {
+                "resources": spec.resources, "strategy": "LOCAL",
+                "timeout": self.config.lease_timeout_s,
+            }, timeout=self.config.lease_timeout_s + 10)
+            if "error" in grant or "spillback" in grant:
+                raise RuntimeError(f"actor placement failed: {grant}")
+            worker_addr = tuple(grant["worker_address"])
+            conn = await self._worker_conn(worker_addr)
+            reply = await conn.call("push_task", {"spec": spec})
+        except Exception as e:
+            from ray_tpu.core.task_error import TaskError
+
+            await self.gcs.call("actor_failed", {
+                "actor_id": st.actor_id,
+                "error": f"placement failed: {e}",
+                "resources": spec.resources,
+            })
+            st.dead = True
+            st.death_cause = str(e)
+            st.ready.set()
+            self._fail_returns(spec, TaskError("ActorDiedError", str(e), ""))
+            return
+        if reply["status"] != "ok":
+            self._record_returns(spec, reply)
+            await self.gcs.call("actor_failed", {
+                "actor_id": st.actor_id, "error": "creation task failed",
+            })
+            st.dead = True
+            st.death_cause = "creation failed"
+            st.ready.set()
+            return
+        # Pin the worker to this actor for life.
+        await raylet.call("release_lease", {
+            "worker_id": grant["worker_id"],
+            "actor_id": st.actor_id,
+            "resources": spec.resources,
+        })
+        st.address = tuple(reply["actor_address"])
+        st.conn = conn
+        await self.gcs.call("actor_started", {
+            "actor_id": st.actor_id,
+            "address": st.address,
+            "node_id": node_id,
+        })
+        st.ready.set()
+        self._record_returns(spec, reply)
+
+    def actor_state(self, actor_id: bytes) -> ActorState:
+        st = self._actors.get(actor_id)
+        if st is None:
+            st = ActorState(actor_id)
+            self._actors[actor_id] = st
+        return st
+
+    def submit_actor_task(
+        self,
+        actor_id: bytes,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+    ) -> list:
+        from ray_tpu.api import ObjectRef
+
+        st = self.actor_state(actor_id)
+        task_id = TaskID.for_actor_task(ActorID(actor_id))
+        arg_specs, kw_keys = self._build_args(args, kwargs)
+        n = max(num_returns, 0)
+        return_ids = [
+            ObjectID.for_return(task_id, i).binary() for i in range(max(n, 1))
+        ]
+        spec = TaskSpec(
+            kind=ACTOR_TASK,
+            task_id=task_id.binary(),
+            job_id=self.job_id,
+            name=method_name,
+            fn_blob=None,
+            args=arg_specs,
+            kwargs_keys=kw_keys,
+            num_returns=n,
+            return_ids=return_ids,
+            actor_id=actor_id,
+            method_name=method_name,
+        )
+        for rid in return_ids:
+            self._result_events[rid] = threading.Event()
+        asyncio.run_coroutine_threadsafe(
+            self._drive_actor_task(st, spec), self._loop
+        )
+        refs = [ObjectRef(ObjectID(rid)) for rid in return_ids[:max(n, 1)]]
+        return refs if n != 1 else refs[:1]
+
+    async def _drive_actor_task(self, st: ActorState, spec: TaskSpec) -> None:
+        from ray_tpu.core.task_error import TaskError
+
+        for attempt in range(100):
+            # Wait until the actor is ALIVE (creation/restart may be slow —
+            # bounded only by the lease timeout, not this loop).
+            try:
+                await asyncio.wait_for(
+                    st.ready.wait(), self.config.lease_timeout_s * 2
+                )
+            except asyncio.TimeoutError:
+                self._fail_returns(spec, TaskError(
+                    "ActorUnavailableError",
+                    "timed out waiting for actor to start", "",
+                ))
+                return
+            if st.dead:
+                self._fail_returns(spec, TaskError(
+                    "ActorDiedError",
+                    f"actor is dead: {st.death_cause}", "",
+                ))
+                return
+            if st.address is None:
+                # Another owner's actor: resolve via GCS.
+                info = await self.gcs.call("get_actor", {"actor_id": st.actor_id})
+                if info is None or info["state"] == "DEAD":
+                    st.dead = True
+                    st.death_cause = (info or {}).get("death_cause", "not found")
+                    continue
+                if info["state"] == "ALIVE" and info["address"]:
+                    st.address = tuple(info["address"])
+                else:
+                    st.ready.clear()
+                    await asyncio.sleep(0.05)
+                    continue
+            try:
+                conn = st.conn
+                if conn is None or conn.closed:
+                    conn = await self._worker_conn(st.address)
+                    st.conn = conn
+                spec.seq_no = next(st.seq)
+                reply = await conn.call("push_task", {"spec": spec})
+                if reply.get("status") == "actor_missing":
+                    st.address = None
+                    st.conn = None
+                    st.ready.clear()
+                    await asyncio.sleep(0.05)
+                    continue
+                self._record_returns(spec, reply)
+                return
+            except (rpc.ConnectionLost, rpc.RpcError) as e:
+                # Actor worker died: ask GCS about restart
+                # (ref: direct_actor_task_submitter.cc DisconnectActor).
+                st.address = None
+                st.conn = None
+                st.ready.clear()
+                resp = await self.gcs.call("actor_failed", {
+                    "actor_id": st.actor_id,
+                    "error": str(e),
+                    "resources": st.resources,
+                })
+                if resp.get("restart"):
+                    await self._restart_actor(
+                        st, tuple(resp["node_address"]), resp.get("node_id", b"")
+                    )
+                    continue
+                st.dead = True
+                st.death_cause = str(e)
+                st.ready.set()
+        self._fail_returns(spec, TaskError(
+            "ActorUnavailableError", "actor task retry budget exhausted", "",
+        ))
+
+    _restart_locks: dict | None = None
+
+    async def _restart_actor(self, st: ActorState, node_address,
+                             node_id: bytes = b"") -> None:
+        """Replay the creation spec on a fresh worker
+        (ref: gcs_actor_manager.cc:1068-1079 restart path)."""
+        raw = await self.gcs.call("kv_get", {"ns": "actor_spec",
+                                             "key": st.actor_id})
+        if raw is None:
+            st.dead = True
+            st.death_cause = "creation spec lost"
+            st.ready.set()
+            return
+        spec: TaskSpec = serialization.loads_call(raw)
+        # Fresh return ids: the original creation return is already consumed.
+        task_id = TaskID.for_actor_task(ActorID(st.actor_id))
+        spec.task_id = task_id.binary()
+        spec.return_ids = [ObjectID.for_return(task_id, 0).binary()]
+        st.dead = False
+        try:
+            await self._place_actor(st, spec, node_address, node_id)
+        except Exception as e:
+            logger.warning("actor restart failed: %s", e)
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
+        st = self.actor_state(actor_id)
+        resp = self._run(self.gcs.call("kill_actor", {"actor_id": actor_id}))
+        st.dead = True
+        st.death_cause = "killed"
+        addr = resp.get("address") if isinstance(resp, dict) else None
+        addr = addr or st.address
+        if addr:
+            async def _send_kill():
+                try:
+                    conn = await self._worker_conn(tuple(addr))
+                    await conn.call("kill_actor", {
+                        "actor_id": actor_id, "no_restart": no_restart,
+                    }, timeout=2)
+                except Exception:
+                    pass
+
+            try:
+                self._run(_send_kill())
+            except Exception:
+                pass
+
+    def get_named_actor(self, name: str) -> bytes | None:
+        info = self._run(self.gcs.call("get_actor", {"name": name}))
+        if info is None or info["state"] == "DEAD":
+            return None
+        st = self.actor_state(info["actor_id"])
+        if info["address"]:
+            st.address = tuple(info["address"])
+        return info["actor_id"]
+
+    # ------------------------------------------------------------ cluster info
+
+    def cluster_view(self) -> dict:
+        return self._run(self.gcs.call("get_cluster_view", {}))
+
+
+class _TaskErrorSentinel:
+    def __init__(self, err):
+        self.err = err

@@ -1,0 +1,98 @@
+"""Typed, env-overridable config registry.
+
+Parity with the reference's flat-file config (`/root/reference/src/ray/common/
+ray_config_def.h:18` — 181 RAY_CONFIG entries, overridable via RAY_<name> env
+vars and `ray.init(_system_config=...)`). Here: declare once, override via
+`RAY_TPU_<NAME>` env vars or `init(_system_config={...})`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _env(name: str, typ, default):
+    raw = os.environ.get(_ENV_PREFIX + name.upper())
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return typ(raw)
+
+
+@dataclasses.dataclass
+class Config:
+    # --- object store ---
+    # Objects <= this many bytes are inlined in RPCs instead of going through
+    # shared memory (ref: ray_config_def.h:210 max_direct_call_object_size).
+    max_inline_object_size: int = 100 * 1024
+    # Per-node shared-memory store capacity.
+    object_store_memory: int = 2 * 1024**3
+    # Chunk size for node-to-node object transfer
+    # (ref: ray_config_def.h:329 object_manager_default_chunk_size = 5 MiB).
+    object_transfer_chunk_size: int = 5 * 1024**2
+    # Fraction of store capacity above which spilling kicks in.
+    object_spill_threshold: float = 0.8
+    # Directory for spilled objects (under session dir if relative).
+    spill_dir: str = "spilled_objects"
+
+    # --- scheduling ---
+    # Hybrid policy: pack onto nodes below this utilization, then spread
+    # (ref: raylet/scheduling/policy/hybrid_scheduling_policy.h:24-47).
+    hybrid_threshold: float = 0.5
+    # Max workers spawned per node beyond num_cpus (soft cap).
+    max_workers_per_node: int = 64
+    # Prestarted idle workers per node.
+    prestart_workers: int = 0
+    # Seconds an idle worker survives before reaping.
+    idle_worker_ttl_s: float = 300.0
+
+    # --- fault tolerance ---
+    # Heartbeat period and miss budget
+    # (ref: ray_config_def.h:55,63 num_heartbeats_timeout=30).
+    heartbeat_period_s: float = 0.5
+    heartbeat_miss_limit: int = 10
+    # Default task retries / actor restarts
+    # (ref: _private/ray_option_utils.py:118,158).
+    default_max_retries: int = 3
+    default_max_restarts: int = 0
+    # Worker lease request timeout.
+    lease_timeout_s: float = 60.0
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_max_frame_bytes: int = 512 * 1024**2
+
+    # --- paths ---
+    session_dir: str = "/tmp/ray_tpu"
+
+    def override(self, overrides: dict[str, Any] | None) -> "Config":
+        if not overrides:
+            return self
+        unknown = set(overrides) - {f.name for f in dataclasses.fields(self)}
+        if unknown:
+            raise ValueError(f"unknown _system_config keys: {sorted(unknown)}")
+        return dataclasses.replace(self, **overrides)
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        kw = {}
+        for f in dataclasses.fields(cls):
+            default = f.default
+            kw[f.name] = _env(f.name, type(default), default)
+        return cls(**kw)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls(**json.loads(s))
+
+
+GLOBAL_CONFIG = Config.from_env()

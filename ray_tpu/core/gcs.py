@@ -1,0 +1,400 @@
+"""GCS — cluster control plane (one per cluster).
+
+Parity with the reference's GcsServer (`/root/reference/src/ray/gcs/
+gcs_server/gcs_server.h:74`): node membership + death broadcast, health
+checks, actor directory + lifecycle + central actor scheduling, jobs, KV
+store, pubsub hub, cluster resource view, and (here) an object-location
+directory. Runs as its own process with an asyncio loop; all state is
+in-memory (a persistence backend mirrors gcs/store_client/ and can be added
+behind `KvBackend`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, JobID, NodeID
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (ref: gcs_actor_manager.cc FSM)
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: bytes
+    address: tuple[str, int]          # raylet RPC endpoint
+    resources_total: dict[str, float]
+    resources_available: dict[str, float]
+    labels: dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    load: int = 0                     # queued lease requests
+
+
+@dataclass
+class ActorInfo:
+    actor_id: bytes
+    name: str | None
+    state: str
+    node_id: bytes | None = None
+    address: tuple[str, int] | None = None   # owning worker RPC endpoint
+    num_restarts: int = 0
+    max_restarts: int = 0
+    create_spec: bytes | None = None          # serialized creation task
+    owner_address: tuple[str, int] | None = None
+    death_cause: str | None = None
+
+
+class GcsServer:
+    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0):
+        self.config = config
+        self.server = rpc.Server(host, port)
+        self.nodes: dict[bytes, NodeInfo] = {}
+        self.actors: dict[bytes, ActorInfo] = {}
+        self.named_actors: dict[str, bytes] = {}
+        self.kv: dict[str, dict[bytes, bytes]] = {}
+        self.object_dir: dict[bytes, set[bytes]] = {}
+        self.subscribers: dict[str, set[rpc.Connection]] = {}
+        self._job_counter = 0
+        self._node_conns: dict[bytes, rpc.Connection] = {}
+        self._register_handlers()
+
+    # ---------- pubsub ----------
+
+    def publish(self, channel: str, msg: Any) -> None:
+        dead = []
+        for conn in self.subscribers.get(channel, ()):  # long-poll parity:
+            if conn.closed:
+                dead.append(conn)
+                continue
+            conn.notify("pub:" + channel, msg)
+        for conn in dead:
+            self.subscribers.get(channel, set()).discard(conn)
+
+    # ---------- handlers ----------
+
+    def _register_handlers(self) -> None:
+        s = self.server
+        s.register("register_node", self._register_node)
+        s.register("heartbeat", self._heartbeat)
+        s.register("get_cluster_view", self._get_cluster_view)
+        s.register("drain_node", self._drain_node)
+        s.register("subscribe", self._subscribe)
+        s.register("next_job_id", self._next_job_id)
+        s.register("kv_put", self._kv_put)
+        s.register("kv_get", self._kv_get)
+        s.register("kv_del", self._kv_del)
+        s.register("kv_keys", self._kv_keys)
+        s.register("register_actor", self._register_actor)
+        s.register("actor_started", self._actor_started)
+        s.register("actor_failed", self._actor_failed)
+        s.register("kill_actor", self._kill_actor)
+        s.register("get_actor", self._get_actor)
+        s.register("list_actors", self._list_actors)
+        s.register("obj_loc_add", self._obj_loc_add)
+        s.register("obj_loc_remove", self._obj_loc_remove)
+        s.register("obj_loc_get", self._obj_loc_get)
+        s.register("obj_free", self._obj_free)
+        s.on_disconnect(self._handle_disconnect)
+
+    async def _register_node(self, conn, p):
+        node_id = p["node_id"]
+        info = NodeInfo(
+            node_id=node_id,
+            address=tuple(p["address"]),
+            resources_total=dict(p["resources"]),
+            resources_available=dict(p["resources"]),
+            labels=p.get("labels", {}),
+        )
+        self.nodes[node_id] = info
+        self._node_conns[node_id] = conn
+        logger.info("node %s registered at %s", node_id.hex()[:8], info.address)
+        self.publish("node", {"event": "added", "node_id": node_id,
+                              "address": info.address,
+                              "resources": info.resources_total})
+        return {"ok": True}
+
+    async def _heartbeat(self, conn, p):
+        info = self.nodes.get(p["node_id"])
+        if info is None:
+            return {"ok": False, "reregister": True}
+        info.last_heartbeat = time.monotonic()
+        info.resources_available = p["resources_available"]
+        info.load = p.get("load", 0)
+        info.alive = True
+        return {"ok": True}
+
+    async def _get_cluster_view(self, conn, p):
+        return {
+            nid: {
+                "address": n.address,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "alive": n.alive,
+                "load": n.load,
+                "labels": n.labels,
+            }
+            for nid, n in self.nodes.items()
+        }
+
+    async def _drain_node(self, conn, p):
+        self._mark_node_dead(p["node_id"], "drained")
+        return {"ok": True}
+
+    async def _subscribe(self, conn, p):
+        for channel in p["channels"]:
+            self.subscribers.setdefault(channel, set()).add(conn)
+        return {"ok": True}
+
+    async def _next_job_id(self, conn, p):
+        self._job_counter += 1
+        return JobID.from_int(self._job_counter).binary()
+
+    # ---------- KV (ref: gcs_kv_manager.cc) ----------
+
+    async def _kv_put(self, conn, p):
+        ns = self.kv.setdefault(p.get("ns", ""), {})
+        existed = p["key"] in ns
+        if p.get("overwrite", True) or not existed:
+            ns[p["key"]] = p["value"]
+        return {"existed": existed}
+
+    async def _kv_get(self, conn, p):
+        return self.kv.get(p.get("ns", ""), {}).get(p["key"])
+
+    async def _kv_del(self, conn, p):
+        ns = self.kv.get(p.get("ns", ""), {})
+        return {"deleted": ns.pop(p["key"], None) is not None}
+
+    async def _kv_keys(self, conn, p):
+        prefix = p.get("prefix", b"")
+        return [k for k in self.kv.get(p.get("ns", ""), {}) if k.startswith(prefix)]
+
+    # ---------- actors (ref: gcs_actor_manager.cc, gcs_actor_scheduler.cc) ----------
+
+    async def _register_actor(self, conn, p):
+        actor_id = p["actor_id"]
+        name = p.get("name")
+        if name:
+            existing = self.named_actors.get(name)
+            if existing is not None and self.actors[existing].state != DEAD:
+                return {"ok": False, "error": f"actor name {name!r} taken"}
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=name,
+            state=PENDING,
+            max_restarts=p.get("max_restarts", 0),
+            create_spec=p.get("create_spec"),
+            owner_address=tuple(p["owner_address"]) if p.get("owner_address") else None,
+        )
+        self.actors[actor_id] = info
+        if p.get("create_spec") is not None:
+            # durable enough for restart-replay (ref: gcs keeps the creation
+            # task spec to restart actors, gcs_actor_manager.cc)
+            self.kv.setdefault("actor_spec", {})[actor_id] = p["create_spec"]
+        if name:
+            self.named_actors[name] = actor_id
+        node = self._schedule_actor(p.get("resources", {}))
+        if node is None:
+            return {"ok": False, "error": "no feasible node for actor"}
+        info.node_id = node.node_id
+        self._deduct(node, p.get("resources", {}))
+        return {"ok": True, "node_id": node.node_id, "node_address": node.address}
+
+    def _schedule_actor(self, resources: dict[str, float]) -> NodeInfo | None:
+        """Central actor scheduling: least-loaded feasible node
+        (ref: gcs_actor_scheduler.cc:49)."""
+        best, best_score = None, None
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            if not all(
+                n.resources_total.get(k, 0) >= v for k, v in resources.items()
+            ):
+                continue
+            avail = all(
+                n.resources_available.get(k, 0) >= v for k, v in resources.items()
+            )
+            score = (not avail, n.load, -sum(n.resources_available.values()))
+            if best_score is None or score < best_score:
+                best, best_score = n, score
+        return best
+
+    def _deduct(self, node: NodeInfo, resources: dict[str, float]) -> None:
+        for k, v in resources.items():
+            node.resources_available[k] = node.resources_available.get(k, 0) - v
+
+    async def _actor_started(self, conn, p):
+        info = self.actors[p["actor_id"]]
+        info.state = ALIVE
+        info.address = tuple(p["address"])
+        info.node_id = p["node_id"]
+        self.publish("actor", {"actor_id": p["actor_id"], "state": ALIVE,
+                               "address": info.address})
+        return {"ok": True}
+
+    async def _actor_failed(self, conn, p):
+        info = self.actors.get(p["actor_id"])
+        if info is None or info.state == DEAD:
+            return {"ok": True, "restart": False}
+        if info.max_restarts == -1 or info.num_restarts < info.max_restarts:
+            info.num_restarts += 1
+            info.state = RESTARTING
+            self.publish("actor", {"actor_id": p["actor_id"], "state": RESTARTING})
+            node = self._schedule_actor(p.get("resources", {}))
+            if node is not None:
+                info.node_id = node.node_id
+                return {"ok": True, "restart": True,
+                        "node_id": node.node_id, "node_address": node.address,
+                        "num_restarts": info.num_restarts}
+        info.state = DEAD
+        info.death_cause = p.get("error", "worker died")
+        self.publish("actor", {"actor_id": p["actor_id"], "state": DEAD,
+                               "cause": info.death_cause})
+        return {"ok": True, "restart": False}
+
+    async def _kill_actor(self, conn, p):
+        info = self.actors.get(p["actor_id"])
+        if info is None:
+            return {"ok": False}
+        info.state = DEAD
+        info.death_cause = "ray_tpu.kill"
+        if info.name:
+            self.named_actors.pop(info.name, None)
+        self.publish("actor", {"actor_id": p["actor_id"], "state": DEAD,
+                               "cause": "killed"})
+        return {"ok": True, "address": info.address}
+
+    async def _get_actor(self, conn, p):
+        actor_id = p.get("actor_id")
+        if actor_id is None and p.get("name") is not None:
+            actor_id = self.named_actors.get(p["name"])
+        if actor_id is None:
+            return None
+        info = self.actors.get(actor_id)
+        if info is None:
+            return None
+        return {
+            "actor_id": info.actor_id, "state": info.state,
+            "address": info.address, "node_id": info.node_id,
+            "name": info.name, "num_restarts": info.num_restarts,
+            "death_cause": info.death_cause,
+        }
+
+    async def _list_actors(self, conn, p):
+        return [
+            {"actor_id": a.actor_id, "state": a.state, "name": a.name,
+             "node_id": a.node_id}
+            for a in self.actors.values()
+        ]
+
+    # ---------- object directory ----------
+
+    async def _obj_loc_add(self, conn, p):
+        for obj in p["object_ids"]:
+            self.object_dir.setdefault(obj, set()).add(p["node_id"])
+        return {"ok": True}
+
+    async def _obj_loc_remove(self, conn, p):
+        locs = self.object_dir.get(p["object_id"])
+        if locs:
+            locs.discard(p["node_id"])
+        return {"ok": True}
+
+    async def _obj_loc_get(self, conn, p):
+        locs = self.object_dir.get(p["object_id"], set())
+        return [
+            {"node_id": nid, "address": self.nodes[nid].address}
+            for nid in locs
+            if nid in self.nodes and self.nodes[nid].alive
+        ]
+
+    async def _obj_free(self, conn, p):
+        """Broadcast delete to all holders."""
+        for obj in p["object_ids"]:
+            for nid in self.object_dir.pop(obj, set()):
+                node_conn = self._node_conns.get(nid)
+                if node_conn is not None and not node_conn.closed:
+                    node_conn.notify("free_objects", {"object_ids": [obj]})
+        return {"ok": True}
+
+    # ---------- failure detection ----------
+
+    def _handle_disconnect(self, conn) -> None:
+        for nid, c in list(self._node_conns.items()):
+            if c is conn:
+                self._mark_node_dead(nid, "connection lost")
+
+    def _mark_node_dead(self, node_id: bytes, why: str) -> None:
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        logger.warning("node %s dead: %s", node_id.hex()[:8], why)
+        self._node_conns.pop(node_id, None)
+        for obj, locs in list(self.object_dir.items()):
+            locs.discard(node_id)
+        self.publish("node", {"event": "dead", "node_id": node_id})
+        # Fail-over actors that lived there.
+        for info_a in list(self.actors.values()):
+            if info_a.node_id == node_id and info_a.state in (ALIVE, PENDING):
+                asyncio.ensure_future(
+                    self._actor_failed(None, {"actor_id": info_a.actor_id,
+                                              "error": f"node died ({why})"})
+                )
+
+    async def _health_loop(self) -> None:
+        period = self.config.heartbeat_period_s
+        limit = period * self.config.heartbeat_miss_limit
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for nid, info in list(self.nodes.items()):
+                if info.alive and now - info.last_heartbeat > limit:
+                    self._mark_node_dead(nid, "heartbeat timeout")
+
+    async def start(self) -> tuple[str, int]:
+        addr = await self.server.start()
+        asyncio.ensure_future(self._health_loop())
+        logger.info("GCS listening on %s", addr)
+        return addr
+
+    async def stop(self) -> None:
+        await self.server.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--ready-fd", type=int, default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="[gcs] %(levelname)s %(message)s")
+    config = Config.from_json(open(args.config).read()) if args.config else Config.from_env()
+
+    async def run():
+        gcs = GcsServer(config, args.host, args.port)
+        host, port = await gcs.start()
+        if args.ready_fd is not None:
+            import os
+
+            os.write(args.ready_fd, f"{host}:{port}\n".encode())
+            os.close(args.ready_fd)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

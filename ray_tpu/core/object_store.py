@@ -1,0 +1,282 @@
+"""Per-node shared-memory object store (plasma equivalent).
+
+Parity target: the reference's plasma store (`/root/reference/src/ray/
+object_manager/plasma/store.h:55`) — an mmap'd arena shared across all
+processes on a node with zero-copy reads, eviction, spilling, and
+backpressured creation. TPU-first simplifications:
+
+- Segments are files under /dev/shm mmap'd by name (same kernel mechanism as
+  plasma's fd-passing without the unix-socket dance; attach-by-name replaces
+  fling.cc). One segment per object; a slab arena + C++ allocator is a later
+  optimization.
+- The store's *metadata* (what exists, where, sealed state, pins) lives in the
+  node daemon process; clients create/write/seal segments directly and only
+  metadata crosses the RPC boundary — data never does (except inline small
+  objects, ref: ray_config_def.h:210 max_direct_call_object_size=100KB).
+- Spill-to-disk under memory pressure + restore on demand
+  (ref: local_object_manager.h:41, external_storage.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import mmap
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core import serialization
+
+logger = logging.getLogger(__name__)
+
+SHM_DIR = "/dev/shm"
+
+
+def shm_path(name: str) -> str:
+    return os.path.join(SHM_DIR, name)
+
+
+def create_segment(name: str, size: int) -> memoryview:
+    """Create + mmap a shared segment; returns writable view."""
+    path = shm_path(name)
+    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+    try:
+        os.ftruncate(fd, size)
+        mm = mmap.mmap(fd, size)
+    finally:
+        os.close(fd)
+    return memoryview(mm)
+
+
+def attach_segment(name: str, size: int) -> memoryview:
+    path = shm_path(name)
+    fd = os.open(path, os.O_RDWR)
+    try:
+        mm = mmap.mmap(fd, size)
+    finally:
+        os.close(fd)
+    return memoryview(mm)
+
+
+def unlink_segment(name: str) -> None:
+    try:
+        os.unlink(shm_path(name))
+    except FileNotFoundError:
+        pass
+
+
+def segment_name(node_hex: str, obj: ObjectID) -> str:
+    return f"raytpu-{node_hex[:8]}-{obj.hex()}"
+
+
+# Entry locations
+INLINE, SHM, SPILLED = "inline", "shm", "spilled"
+
+
+@dataclass
+class Entry:
+    location: str
+    size: int
+    sealed: bool = False
+    data: bytes | None = None          # INLINE
+    shm_name: str | None = None        # SHM
+    spill_path: str | None = None      # SPILLED
+    pins: int = 0                      # active readers / creators
+    last_used: float = field(default_factory=time.monotonic)
+    # mmap views held by the store itself (for transfer serving)
+    _view: memoryview | None = None
+
+
+class LocalObjectStore:
+    """Authoritative per-node store metadata + spill/evict engine.
+
+    Runs inside the node daemon's asyncio loop; all methods are
+    single-threaded coroutine-safe.
+    """
+
+    def __init__(self, node_hex: str, config: Config, spill_dir: str):
+        self.node_hex = node_hex
+        self.config = config
+        self.spill_dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        self.entries: dict[ObjectID, Entry] = {}
+        self.shm_bytes = 0
+        self._seal_events: dict[ObjectID, asyncio.Event] = {}
+        self.capacity = config.object_store_memory
+
+    # ---- creation ----
+
+    def put_inline(self, obj_id: ObjectID, data: bytes) -> None:
+        if obj_id in self.entries:
+            return
+        self.entries[obj_id] = Entry(
+            location=INLINE, size=len(data), sealed=True, data=data
+        )
+        self._wake(obj_id)
+
+    async def create(self, obj_id: ObjectID, size: int) -> str:
+        """Reserve a segment for a client to fill; returns shm name."""
+        if obj_id in self.entries:
+            e = self.entries[obj_id]
+            if e.location == SHM and not e.sealed:
+                return e.shm_name  # idempotent re-create
+            raise KeyError(f"{obj_id} already exists")
+        await self._ensure_space(size)
+        name = segment_name(self.node_hex, obj_id)
+        view = create_segment(name, size)
+        self.entries[obj_id] = Entry(
+            location=SHM, size=size, shm_name=name, _view=view
+        )
+        self.shm_bytes += size
+        return name
+
+    def seal(self, obj_id: ObjectID) -> None:
+        e = self.entries[obj_id]
+        e.sealed = True
+        e.last_used = time.monotonic()
+        self._wake(obj_id)
+
+    def _wake(self, obj_id: ObjectID) -> None:
+        ev = self._seal_events.pop(obj_id, None)
+        if ev is not None:
+            ev.set()
+
+    # ---- reads ----
+
+    def contains(self, obj_id: ObjectID) -> bool:
+        e = self.entries.get(obj_id)
+        return e is not None and e.sealed
+
+    async def wait_sealed(self, obj_id: ObjectID, timeout: float | None) -> bool:
+        if self.contains(obj_id):
+            return True
+        ev = self._seal_events.setdefault(obj_id, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def describe(self, obj_id: ObjectID) -> tuple[str, Any]:
+        """→ ("inline", bytes) | ("shm", (name, size)). Restores spills."""
+        e = self.entries[obj_id]
+        e.last_used = time.monotonic()
+        if e.location == INLINE:
+            return INLINE, e.data
+        if e.location == SPILLED:
+            await self._restore(obj_id, e)
+        return SHM, (e.shm_name, e.size)
+
+    def pin(self, obj_id: ObjectID, delta: int = 1) -> None:
+        e = self.entries.get(obj_id)
+        if e is not None:
+            e.pins = max(0, e.pins + delta)
+
+    def read_bytes(self, obj_id: ObjectID, offset: int, length: int) -> bytes:
+        """For node-to-node transfer serving (chunked)."""
+        e = self.entries[obj_id]
+        if e.location == INLINE:
+            return e.data[offset : offset + length]
+        if e.location == SPILLED:
+            with open(e.spill_path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        view = e._view
+        if view is None:
+            view = attach_segment(e.shm_name, e.size)
+            e._view = view
+        return bytes(view[offset : offset + length])
+
+    # ---- delete / evict / spill ----
+
+    def free(self, obj_id: ObjectID) -> None:
+        e = self.entries.pop(obj_id, None)
+        if e is None:
+            return
+        if e.location == SHM:
+            self.shm_bytes -= e.size
+            if e._view is not None:
+                e._view.release()
+            unlink_segment(e.shm_name)
+        elif e.location == SPILLED and e.spill_path:
+            try:
+                os.unlink(e.spill_path)
+            except FileNotFoundError:
+                pass
+
+    async def _ensure_space(self, incoming: int) -> None:
+        """Backpressured creation: spill LRU sealed unpinned objects until the
+        new segment fits (ref: create_request_queue.cc semantics)."""
+        limit = int(self.capacity * self.config.object_spill_threshold)
+        if self.shm_bytes + incoming <= limit:
+            return
+        victims = sorted(
+            (
+                (e.last_used, oid)
+                for oid, e in self.entries.items()
+                if e.location == SHM and e.sealed and e.pins == 0
+            ),
+        )
+        for _, oid in victims:
+            if self.shm_bytes + incoming <= limit:
+                break
+            await self._spill(oid)
+        if self.shm_bytes + incoming > self.capacity:
+            raise MemoryError(
+                f"object store full: {self.shm_bytes}+{incoming} > {self.capacity}"
+            )
+
+    async def _spill(self, obj_id: ObjectID) -> None:
+        e = self.entries[obj_id]
+        path = os.path.join(self.spill_dir, obj_id.hex())
+        view = e._view or attach_segment(e.shm_name, e.size)
+        data = bytes(view)
+        await asyncio.to_thread(self._write_file, path, data)
+        view.release()
+        e._view = None
+        unlink_segment(e.shm_name)
+        self.shm_bytes -= e.size
+        e.location = SPILLED
+        e.spill_path = path
+        e.shm_name = None
+        logger.debug("spilled %s (%d bytes)", obj_id.hex()[:12], e.size)
+
+    @staticmethod
+    def _write_file(path: str, data: bytes) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    async def _restore(self, obj_id: ObjectID, e: Entry) -> None:
+        await self._ensure_space(e.size)
+        name = segment_name(self.node_hex, obj_id)
+        data = await asyncio.to_thread(lambda: open(e.spill_path, "rb").read())
+        view = create_segment(name, e.size)
+        view[:] = data
+        self.shm_bytes += e.size
+        os.unlink(e.spill_path)
+        e.location = SHM
+        e.shm_name = name
+        e.spill_path = None
+        e._view = view
+
+    # ---- introspection ----
+
+    def stats(self) -> dict:
+        return {
+            "objects": len(self.entries),
+            "shm_bytes": self.shm_bytes,
+            "capacity": self.capacity,
+            "spilled": sum(
+                1 for e in self.entries.values() if e.location == SPILLED
+            ),
+        }
+
+    def shutdown(self) -> None:
+        for oid in list(self.entries):
+            self.free(oid)

@@ -1,0 +1,606 @@
+"""Node daemon ("raylet"): worker pool + lease scheduling + object plane.
+
+Parity with the reference's per-node NodeManager (`/root/reference/src/ray/
+raylet/node_manager.h:144`): worker leasing with spillback
+(`HandleRequestWorkerLease`, node_manager.cc:1880), a worker pool that spawns/
+reuses processes (`worker_pool.cc`), the local object store (plasma; here
+object_store.py), chunked node-to-node object transfer
+(`object_manager.proto:63-65`), and heartbeats to the GCS.
+
+Scheduling is the reference's hybrid policy (`raylet/scheduling/policy/
+hybrid_scheduling_policy.h:24-47`): grant locally while local utilization is
+below a threshold; otherwise spill to the least-loaded feasible node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.core import rpc, serialization
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
+from ray_tpu.core.object_store import LocalObjectStore
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: bytes
+    pid: int
+    address: tuple[str, int] | None = None   # worker's RPC server
+    conn: rpc.Connection | None = None       # raylet→worker connection
+    idle: bool = True
+    actor_id: bytes | None = None            # pinned if hosting an actor
+    lease_resources: dict[str, float] = field(default_factory=dict)
+    started: float = field(default_factory=time.monotonic)
+    proc: Any = None
+
+
+@dataclass
+class LeaseRequest:
+    resources: dict[str, float]
+    strategy: Any
+    future: asyncio.Future
+    enqueued: float = field(default_factory=time.monotonic)
+
+
+class Raylet:
+    def __init__(
+        self,
+        config: Config,
+        gcs_address: tuple[str, int],
+        resources: dict[str, float],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        session_dir: str | None = None,
+        labels: dict[str, str] | None = None,
+    ):
+        self.config = config
+        self.node_id = NodeID.from_random().binary()
+        self.gcs_address = gcs_address
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.labels = labels or {}
+        self.server = rpc.Server(host, port)
+        self.session_dir = session_dir or os.path.join(
+            config.session_dir, "session-default"
+        )
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.store = LocalObjectStore(
+            NodeID(self.node_id).hex(),
+            config,
+            os.path.join(self.session_dir, config.spill_dir,
+                         NodeID(self.node_id).hex()[:8]),
+        )
+        self.workers: dict[bytes, WorkerHandle] = {}
+        self.lease_queue: list[LeaseRequest] = []
+        self.gcs: rpc.Connection | None = None
+        self.cluster_view: dict[bytes, dict] = {}
+        self._pulls_inflight: dict[bytes, asyncio.Future] = {}
+        self._peer_conns: dict[tuple[str, int], rpc.Connection] = {}
+        self._shutdown = False
+        self._register_handlers()
+
+    # ------------------------------------------------------------------ setup
+
+    def _register_handlers(self) -> None:
+        s = self.server
+        # worker lifecycle
+        s.register("register_worker", self._h_register_worker)
+        # leasing
+        s.register("request_lease", self._h_request_lease)
+        s.register("release_lease", self._h_release_lease)
+        # object plane (local clients)
+        s.register("store_create", self._h_store_create)
+        s.register("store_seal", self._h_store_seal)
+        s.register("store_put_inline", self._h_store_put_inline)
+        s.register("store_get", self._h_store_get)
+        s.register("store_contains", self._h_store_contains)
+        s.register("store_free", self._h_store_free)
+        s.register("store_stats", self._h_store_stats)
+        s.register("store_pin", self._h_store_pin)
+        # object plane (remote raylets)
+        s.register("obj_read_chunk", self._h_obj_read_chunk)
+        s.register("obj_info", self._h_obj_info)
+        s.register("node_info", self._h_node_info)
+        s.on_disconnect(self._handle_disconnect)
+
+    async def start(self) -> tuple[str, int]:
+        addr = await self.server.start()
+        self.address = addr
+        self.gcs = await rpc.connect(
+            *self.gcs_address,
+            timeout=self.config.rpc_connect_timeout_s,
+            notify_handler=self._gcs_notify,
+        )
+        await self.gcs.call("register_node", {
+            "node_id": self.node_id,
+            "address": addr,
+            "resources": self.resources_total,
+            "labels": self.labels,
+        })
+        await self.gcs.call("subscribe", {"channels": ["node"]})
+        view = await self.gcs.call("get_cluster_view", {})
+        self.cluster_view = view
+        asyncio.ensure_future(self._heartbeat_loop())
+        asyncio.ensure_future(self._reap_idle_loop())
+        for _ in range(self.config.prestart_workers):
+            self._spawn_worker()
+        logger.info(
+            "raylet %s up at %s resources=%s",
+            NodeID(self.node_id).hex()[:8], addr, self.resources_total,
+        )
+        return addr
+
+    def _gcs_notify(self, method: str, payload: Any) -> None:
+        if method == "pub:node":
+            ev = payload
+            if ev["event"] == "added":
+                self.cluster_view[ev["node_id"]] = {
+                    "address": tuple(ev["address"]),
+                    "resources_total": ev["resources"],
+                    "resources_available": dict(ev["resources"]),
+                    "alive": True, "load": 0, "labels": {},
+                }
+            elif ev["event"] == "dead":
+                info = self.cluster_view.get(ev["node_id"])
+                if info:
+                    info["alive"] = False
+        elif method == "free_objects":
+            for ob in payload["object_ids"]:
+                self.store.free(ObjectID(ob))
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(self.config.heartbeat_period_s)
+            try:
+                resp = await self.gcs.call("heartbeat", {
+                    "node_id": self.node_id,
+                    "resources_available": self.resources_available,
+                    "load": len(self.lease_queue),
+                }, timeout=5.0)
+                if resp.get("reregister"):
+                    await self.gcs.call("register_node", {
+                        "node_id": self.node_id,
+                        "address": self.address,
+                        "resources": self.resources_total,
+                        "labels": self.labels,
+                    })
+                # refresh cluster view opportunistically
+                self.cluster_view = await self.gcs.call("get_cluster_view", {})
+            except (rpc.ConnectionLost, asyncio.TimeoutError):
+                if self._shutdown:
+                    return
+                logger.warning("GCS unreachable; retrying connect")
+                try:
+                    self.gcs = await rpc.connect(
+                        *self.gcs_address, timeout=30.0,
+                        notify_handler=self._gcs_notify,
+                    )
+                    await self.gcs.call("register_node", {
+                        "node_id": self.node_id,
+                        "address": self.address,
+                        "resources": self.resources_total,
+                        "labels": self.labels,
+                    })
+                    await self.gcs.call("subscribe", {"channels": ["node"]})
+                except rpc.ConnectionLost:
+                    pass
+
+    # ------------------------------------------------------- worker pool
+
+    def _spawn_worker(self) -> WorkerHandle:
+        worker_id = WorkerID.from_random().binary()
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = WorkerID(worker_id).hex()
+        cmd = [
+            sys.executable, "-m", "ray_tpu.core.worker",
+            "--raylet", f"{self.address[0]}:{self.address[1]}",
+            "--gcs", f"{self.gcs_address[0]}:{self.gcs_address[1]}",
+            "--node-id", NodeID(self.node_id).hex(),
+            "--worker-id", WorkerID(worker_id).hex(),
+            "--session-dir", self.session_dir,
+        ]
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{WorkerID(worker_id).hex()[:8]}.log"), "ab")
+        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc, idle=False)
+        self.workers[worker_id] = handle
+        return handle
+
+    async def _h_register_worker(self, conn, p):
+        worker_id = p["worker_id"]
+        handle = self.workers.get(worker_id)
+        if handle is None:  # externally spawned (tests)
+            handle = WorkerHandle(worker_id=worker_id, pid=p.get("pid", -1))
+            self.workers[worker_id] = handle
+        handle.address = tuple(p["address"])
+        handle.conn = conn
+        handle.idle = True
+        self._pump_leases()
+        return {"node_id": self.node_id, "ok": True}
+
+    def _handle_disconnect(self, conn) -> None:
+        for wid, h in list(self.workers.items()):
+            if h.conn is conn:
+                logger.warning("worker %s disconnected", WorkerID(wid).hex()[:8])
+                self._return_resources(h)
+                self.workers.pop(wid, None)
+
+    def _return_resources(self, h: WorkerHandle) -> None:
+        for k, v in h.lease_resources.items():
+            self.resources_available[k] = self.resources_available.get(k, 0) + v
+        h.lease_resources = {}
+
+    async def _reap_idle_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(5.0)
+            now = time.monotonic()
+            excess = [
+                h for h in self.workers.values()
+                if h.idle and h.actor_id is None
+                and now - h.started > self.config.idle_worker_ttl_s
+            ]
+            min_keep = max(1, self.config.prestart_workers)
+            for h in excess[: max(0, len(excess) - min_keep)]:
+                if h.conn is not None:
+                    h.conn.notify("exit", {})
+
+    # ------------------------------------------------------- leasing
+
+    def _feasible(self, resources: dict[str, float]) -> bool:
+        return all(
+            self.resources_total.get(k, 0) >= v for k, v in resources.items()
+        )
+
+    def _available(self, resources: dict[str, float]) -> bool:
+        return all(
+            self.resources_available.get(k, 0) >= v
+            for k, v in resources.items()
+        )
+
+    def _utilization(self) -> float:
+        fracs = [
+            1 - self.resources_available.get(k, 0) / v
+            for k, v in self.resources_total.items()
+            if v > 0
+        ]
+        return max(fracs) if fracs else 0.0
+
+    def _pick_spill_node(self, resources: dict[str, float]) -> tuple | None:
+        """Hybrid policy step 2: least-loaded remote feasible node with
+        availability (ref: hybrid_scheduling_policy.h:24-47)."""
+        best, best_score = None, None
+        for nid, n in self.cluster_view.items():
+            if nid == self.node_id or not n.get("alive", True):
+                continue
+            tot, avail = n["resources_total"], n["resources_available"]
+            if not all(tot.get(k, 0) >= v for k, v in resources.items()):
+                continue
+            has = all(avail.get(k, 0) >= v for k, v in resources.items())
+            score = (not has, n.get("load", 0))
+            if best_score is None or score < best_score:
+                best, best_score = tuple(n["address"]), score
+        return best
+
+    async def _h_request_lease(self, conn, p):
+        resources = p.get("resources", {})
+        strategy = p.get("strategy")
+        affinity = None
+        if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
+            affinity = strategy
+        if affinity is not None and affinity.get("node_id") != self.node_id:
+            target = self.cluster_view.get(affinity["node_id"])
+            if target is not None and target.get("alive", True):
+                return {"spillback": tuple(target["address"])}
+            if not affinity.get("soft", False):
+                return {"error": "affinity node not available"}
+        if not self._feasible(resources):
+            spill = self._pick_spill_node(resources)
+            if spill is not None:
+                return {"spillback": spill}
+            return {"error": f"no node can satisfy resources {resources}"}
+        # hybrid: spill when saturated locally and someone else has room
+        if (
+            affinity is None
+            and strategy != "LOCAL"
+            and not self._available(resources)
+        ) or (strategy == "SPREAD" and self._utilization() > 0):
+            spill = self._pick_spill_node(resources)
+            if spill is not None and (
+                not self._available(resources)
+                or self._utilization() > self.config.hybrid_threshold
+            ):
+                return {"spillback": spill}
+        req = LeaseRequest(
+            resources=resources, strategy=strategy,
+            future=asyncio.get_running_loop().create_future(),
+        )
+        self.lease_queue.append(req)
+        self._pump_leases()
+        try:
+            grant = await asyncio.wait_for(
+                req.future, p.get("timeout", self.config.lease_timeout_s)
+            )
+            return grant
+        except asyncio.TimeoutError:
+            if req in self.lease_queue:
+                self.lease_queue.remove(req)
+            return {"error": "lease timeout"}
+
+    def _pump_leases(self) -> None:
+        granted = []
+        for req in self.lease_queue:
+            if req.future.done():
+                granted.append(req)
+                continue
+            if not self._available(req.resources):
+                continue
+            worker = self._find_idle_worker()
+            if worker is None:
+                n_alive = len(self.workers)
+                if n_alive < self.config.max_workers_per_node:
+                    self._spawn_worker()
+                continue
+            worker.idle = False
+            worker.lease_resources = dict(req.resources)
+            for k, v in req.resources.items():
+                self.resources_available[k] = (
+                    self.resources_available.get(k, 0) - v
+                )
+            req.future.set_result({
+                "worker_id": worker.worker_id,
+                "worker_address": worker.address,
+            })
+            granted.append(req)
+        for req in granted:
+            if req in self.lease_queue:
+                self.lease_queue.remove(req)
+
+    def _find_idle_worker(self) -> WorkerHandle | None:
+        for h in self.workers.values():
+            if h.idle and h.conn is not None and h.actor_id is None:
+                return h
+        return None
+
+    async def _h_release_lease(self, conn, p):
+        h = self.workers.get(p["worker_id"])
+        if h is not None:
+            self._return_resources(h)
+            if p.get("actor_id"):
+                h.actor_id = p["actor_id"]       # pinned to actor: not reusable
+                # actor holds its resources for life
+                h.lease_resources = p.get("resources", {})
+                for k, v in h.lease_resources.items():
+                    self.resources_available[k] = (
+                        self.resources_available.get(k, 0) - v
+                    )
+            elif p.get("dead"):
+                self.workers.pop(p["worker_id"], None)
+            else:
+                h.idle = True
+                h.started = time.monotonic()
+            self._pump_leases()
+        return {"ok": True}
+
+    # ------------------------------------------------------- object plane
+
+    async def _h_store_create(self, conn, p):
+        name = await self.store.create(ObjectID(p["object_id"]), p["size"])
+        return {"shm_name": name}
+
+    async def _h_store_seal(self, conn, p):
+        obj = ObjectID(p["object_id"])
+        self.store.seal(obj)
+        if not p.get("local_only"):
+            await self.gcs.call("obj_loc_add", {
+                "object_ids": [p["object_id"]], "node_id": self.node_id,
+            })
+        return {"ok": True}
+
+    async def _h_store_put_inline(self, conn, p):
+        obj = ObjectID(p["object_id"])
+        self.store.put_inline(obj, p["data"])
+        if not p.get("local_only"):
+            await self.gcs.call("obj_loc_add", {
+                "object_ids": [p["object_id"]], "node_id": self.node_id,
+            })
+        return {"ok": True}
+
+    async def _h_store_get(self, conn, p):
+        """Resolve objects for a local client; pulls from remote if needed.
+        Returns per-object: ("inline", bytes) | ("shm", (name, size)) |
+        ("missing", None)."""
+        timeout = p.get("timeout")
+        out = []
+        for ob in p["object_ids"]:
+            obj = ObjectID(ob)
+            ok = self.store.contains(obj)
+            if not ok:
+                ok = await self._pull(obj, timeout)
+            if not ok:
+                ok = await self.store.wait_sealed(obj, timeout)
+            if not ok:
+                out.append(("missing", None))
+            else:
+                loc, data = await self.store.describe(obj)
+                out.append((loc, data))
+        return out
+
+    async def _h_store_contains(self, conn, p):
+        return [self.store.contains(ObjectID(ob)) for ob in p["object_ids"]]
+
+    async def _h_store_free(self, conn, p):
+        for ob in p["object_ids"]:
+            self.store.free(ObjectID(ob))
+            asyncio.ensure_future(self.gcs.call("obj_loc_remove", {
+                "object_id": ob, "node_id": self.node_id,
+            }))
+        return {"ok": True}
+
+    async def _h_store_stats(self, conn, p):
+        return self.store.stats()
+
+    async def _h_store_pin(self, conn, p):
+        for ob in p["object_ids"]:
+            self.store.pin(ObjectID(ob), p.get("delta", 1))
+        return {"ok": True}
+
+    async def _h_obj_info(self, conn, p):
+        obj = ObjectID(p["object_id"])
+        if not self.store.contains(obj):
+            return None
+        return {"size": self.store.entries[obj].size,
+                "inline": self.store.entries[obj].location == "inline"}
+
+    async def _h_obj_read_chunk(self, conn, p):
+        obj = ObjectID(p["object_id"])
+        if not self.store.contains(obj):
+            return None
+        return self.store.read_bytes(obj, p["offset"], p["length"])
+
+    async def _peer(self, address: tuple[str, int]) -> rpc.Connection:
+        conn = self._peer_conns.get(address)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*address, timeout=self.config.rpc_connect_timeout_s)
+            self._peer_conns[address] = conn
+        return conn
+
+    async def _pull(self, obj: ObjectID, timeout: float | None) -> bool:
+        """Chunked pull from a remote holder (ref: pull_manager.h:48,
+        object_manager.proto Push/Pull, 5 MiB chunks)."""
+        key = obj.binary()
+        fut = self._pulls_inflight.get(key)
+        if fut is not None:
+            try:
+                return await asyncio.wait_for(
+                    asyncio.shield(fut), timeout
+                )
+            except asyncio.TimeoutError:
+                return False
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls_inflight[key] = fut
+        try:
+            ok = await self._pull_once(obj, timeout)
+            fut.set_result(ok)
+            return ok
+        except Exception as e:
+            fut.set_result(False)
+            logger.warning("pull %s failed: %s", obj.hex()[:12], e)
+            return False
+        finally:
+            self._pulls_inflight.pop(key, None)
+
+    async def _pull_once(self, obj: ObjectID, timeout: float | None) -> bool:
+        locs = await self.gcs.call("obj_loc_get", {"object_id": obj.binary()})
+        for loc in locs:
+            if loc["node_id"] == self.node_id:
+                continue
+            try:
+                peer = await self._peer(tuple(loc["address"]))
+                info = await peer.call("obj_info", {"object_id": obj.binary()},
+                                       timeout=10.0)
+                if info is None:
+                    continue
+                size = info["size"]
+                chunk = self.config.object_transfer_chunk_size
+                if info["inline"]:
+                    data = await peer.call("obj_read_chunk", {
+                        "object_id": obj.binary(), "offset": 0, "length": size,
+                    }, timeout=60.0)
+                    self.store.put_inline(obj, data)
+                else:
+                    name = await self.store.create(obj, size)
+                    from ray_tpu.core.object_store import attach_segment
+
+                    view = self.store.entries[obj]._view
+                    off = 0
+                    while off < size:
+                        n = min(chunk, size - off)
+                        data = await peer.call("obj_read_chunk", {
+                            "object_id": obj.binary(), "offset": off,
+                            "length": n,
+                        }, timeout=60.0)
+                        if data is None:
+                            raise rpc.RpcError("holder dropped object mid-pull")
+                        view[off:off + n] = data
+                        off += n
+                    self.store.seal(obj)
+                await self.gcs.call("obj_loc_add", {
+                    "object_ids": [obj.binary()], "node_id": self.node_id,
+                })
+                return True
+            except (rpc.RpcError, rpc.ConnectionLost, KeyError) as e:
+                logger.debug("pull from %s failed: %s", loc, e)
+                continue
+        return False
+
+    async def _h_node_info(self, conn, p):
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "n_workers": len(self.workers),
+            "store": self.store.stats(),
+        }
+
+    # ------------------------------------------------------- shutdown
+
+    async def stop(self) -> None:
+        self._shutdown = True
+        for h in self.workers.values():
+            if h.conn is not None:
+                h.conn.notify("exit", {})
+            if h.proc is not None:
+                try:
+                    h.proc.terminate()
+                except ProcessLookupError:
+                    pass
+        await self.server.stop()
+        self.store.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gcs", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--resources", default="{}")
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--session-dir", default=None)
+    ap.add_argument("--ready-fd", type=int, default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="[raylet] %(levelname)s %(message)s")
+    import json
+
+    config = Config.from_json(open(args.config).read()) if args.config else Config.from_env()
+    ghost, gport = args.gcs.rsplit(":", 1)
+    resources = json.loads(args.resources)
+
+    async def run():
+        raylet = Raylet(
+            config, (ghost, int(gport)), resources,
+            args.host, args.port, session_dir=args.session_dir,
+        )
+        host, port = await raylet.start()
+        if args.ready_fd is not None:
+            os.write(args.ready_fd, f"{host}:{port}\n".encode())
+            os.close(args.ready_fd)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

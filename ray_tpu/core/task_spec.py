@@ -1,0 +1,55 @@
+"""Task/actor specs that travel over the wire.
+
+Parity with the reference's TaskSpecification (`/root/reference/src/ray/
+common/task/task_spec.h`) minus protobuf: a python dataclass pickled by the
+RPC layer. Small args are inlined in the spec; large args are put in the
+object store by the submitter and referenced
+(ref: `_raylet.pyx:392-497`, `ray_config_def.h:210`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+NORMAL_TASK = "task"
+ACTOR_CREATION = "actor_creation"
+ACTOR_TASK = "actor_task"
+
+
+@dataclass
+class ArgSpec:
+    kind: str                      # "value" | "ref"
+    value: bytes | None = None     # serialized (pack) when kind == "value"
+    object_id: bytes | None = None  # when kind == "ref"
+    owner_address: tuple[str, int] | None = None
+
+
+@dataclass
+class TaskSpec:
+    kind: str
+    task_id: bytes
+    job_id: bytes
+    name: str                           # human-readable fn/method name
+    fn_blob: bytes | None               # cloudpickled callable (task / actor cls)
+    args: list[ArgSpec] = field(default_factory=list)
+    kwargs_keys: list[str] = field(default_factory=list)  # trailing args are kwargs
+    num_returns: int = 1
+    return_ids: list[bytes] = field(default_factory=list)
+    resources: dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_count: int = 0
+    # actor fields
+    actor_id: bytes | None = None
+    method_name: str | None = None
+    seq_no: int = -1                    # per-(caller, actor) ordering
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    actor_name: str | None = None
+    # owner (submitter) — answers "who owns the returns"
+    owner_address: tuple[str, int] | None = None
+    # scheduling
+    scheduling_strategy: Any = None     # None | "SPREAD" | NodeAffinity(...)
+    placement_group_id: bytes | None = None
+    placement_group_bundle_index: int = -1
+    runtime_env: dict | None = None

@@ -1,0 +1,302 @@
+"""Worker process: executes tasks and hosts actors.
+
+Parity with the reference's core-worker execution side (`/root/reference/src/
+ray/core_worker/core_worker.cc` HandlePushTask → `_raylet.pyx:678`
+execute_task): tasks are pushed worker-to-worker over RPC (direct task
+transport, `transport/direct_task_transport.h:57`), actor tasks run on a
+dedicated thread with in-order queues (`actor_scheduling_queue.cc`), returns
+go to the local store (large) and ride the reply (small).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import concurrent.futures
+import logging
+import os
+import sys
+import threading
+import traceback
+from typing import Any
+
+from ray_tpu.core import rpc, serialization
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, ObjectID, WorkerID
+from ray_tpu.core.task_spec import ACTOR_CREATION, ACTOR_TASK, NORMAL_TASK, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+from ray_tpu.core.task_error import TaskError
+
+
+class ActorRuntime:
+    """One hosted actor instance + its ordered execution lane."""
+
+    def __init__(self, actor_id: bytes, instance: Any, max_concurrency: int):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, max_concurrency),
+            thread_name_prefix=f"actor-{ActorID(actor_id).hex()[:8]}",
+        )
+        self.max_concurrency = max_concurrency
+
+
+class Worker:
+    def __init__(
+        self,
+        worker_id: bytes,
+        raylet_address: tuple[str, int],
+        gcs_address: tuple[str, int],
+        node_id: bytes,
+        config: Config,
+        session_dir: str,
+    ):
+        self.worker_id = worker_id
+        self.raylet_address = raylet_address
+        self.gcs_address = gcs_address
+        self.node_id = node_id
+        self.config = config
+        self.session_dir = session_dir
+        self.server = rpc.Server("127.0.0.1", 0)
+        self.raylet: rpc.Connection | None = None
+        self.gcs: rpc.Connection | None = None
+        self.actors: dict[bytes, ActorRuntime] = {}
+        self.task_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task"
+        )
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self.address: tuple[str, int] | None = None
+        self._exit = asyncio.Event()
+        self.current_task_id: bytes | None = None
+        self.server.register("push_task", self._h_push_task)
+        self.server.register("kill_actor", self._h_kill_actor)
+        self.server.register("ping", self._h_ping)
+
+    async def start(self) -> None:
+        self.loop = asyncio.get_running_loop()
+        self.address = await self.server.start()
+        self.raylet = await rpc.connect(
+            *self.raylet_address,
+            timeout=self.config.rpc_connect_timeout_s,
+            notify_handler=self._raylet_notify,
+        )
+        self.gcs = await rpc.connect(
+            *self.gcs_address, timeout=self.config.rpc_connect_timeout_s
+        )
+        await self.raylet.call("register_worker", {
+            "worker_id": self.worker_id,
+            "address": self.address,
+            "pid": os.getpid(),
+        })
+
+        # Fate-sharing: if the raylet goes away, this worker dies with it
+        # (ref: _private/ray_process_reaper.py).
+        async def _watch_raylet():
+            await self.raylet._closed.wait()
+            logger.warning("raylet connection lost; exiting")
+            os._exit(1)
+
+        asyncio.ensure_future(_watch_raylet())
+        # Make this process usable as a client (nested tasks): api.init picks
+        # these up lazily inside executing task code.
+        os.environ["RAY_TPU_RAYLET_ADDRESS"] = (
+            f"{self.raylet_address[0]}:{self.raylet_address[1]}"
+        )
+        os.environ["RAY_TPU_GCS_ADDRESS"] = (
+            f"{self.gcs_address[0]}:{self.gcs_address[1]}"
+        )
+        os.environ["RAY_TPU_SESSION_DIR"] = self.session_dir
+        logger.info("worker %s serving at %s", WorkerID(self.worker_id).hex()[:8],
+                    self.address)
+
+    def _raylet_notify(self, method: str, payload: Any) -> None:
+        if method == "exit":
+            self.loop.call_soon_threadsafe(self._exit.set) if (
+                threading.current_thread() is not threading.main_thread()
+            ) else self._exit.set()
+
+    async def _h_ping(self, conn, p):
+        return {"ok": True, "actors": [a.hex() for a in self.actors]}
+
+    async def _h_kill_actor(self, conn, p):
+        rt = self.actors.get(p["actor_id"])
+        if rt is None:
+            return {"ok": False}
+        if p.get("no_restart", True) or True:
+            # Actor death == worker process death (matches reference:
+            # one actor per worker process).
+            asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return {"ok": True}
+
+    # ------------------------------------------------------------ execution
+
+    async def _h_push_task(self, conn, p):
+        spec: TaskSpec = p["spec"]
+        if spec.kind == ACTOR_TASK:
+            rt = self.actors.get(spec.actor_id)
+            if rt is None:
+                return {"status": "actor_missing"}
+            fut = asyncio.get_running_loop().run_in_executor(
+                rt.pool, self._run_actor_task, rt, spec
+            )
+        elif spec.kind == ACTOR_CREATION:
+            fut = asyncio.get_running_loop().run_in_executor(
+                self.task_pool, self._run_actor_creation, spec
+            )
+        else:
+            fut = asyncio.get_running_loop().run_in_executor(
+                self.task_pool, self._run_normal_task, spec
+            )
+        results, error = await fut
+        reply: dict[str, Any] = {"status": "ok", "worker_id": self.worker_id}
+        if error is not None:
+            reply["status"] = "error"
+        # Store returns; inline small ones in the reply.
+        stored = await self._store_returns(spec, results)
+        reply["returns"] = stored
+        if spec.kind == ACTOR_CREATION and error is None:
+            reply["actor_address"] = self.address
+        return reply
+
+    def _resolve_args(self, spec: TaskSpec) -> tuple[list, dict]:
+        from ray_tpu import api
+
+        client = api._ensure_client()
+        vals: list[Any] = []
+        for a in spec.args:
+            if a.kind == "value":
+                vals.append(serialization.unpack(a.value))
+            else:
+                from ray_tpu.api import ObjectRef
+
+                vals.append(client.get([ObjectRef(ObjectID(a.object_id))])[0])
+        n_kw = len(spec.kwargs_keys)
+        if n_kw:
+            args = vals[:-n_kw]
+            kwargs = dict(zip(spec.kwargs_keys, vals[-n_kw:]))
+        else:
+            args, kwargs = vals, {}
+        return args, kwargs
+
+    def _run_normal_task(self, spec: TaskSpec):
+        self.current_task_id = spec.task_id
+        try:
+            fn = serialization.unpack(spec.fn_blob)
+            args, kwargs = self._resolve_args(spec)
+            out = fn(*args, **kwargs)
+            return self._split_returns(spec, out), None
+        except Exception as e:
+            err = TaskError(type(e).__name__, str(e), traceback.format_exc())
+            return [err] * max(1, spec.num_returns), err
+        finally:
+            self.current_task_id = None
+
+    def _run_actor_creation(self, spec: TaskSpec):
+        try:
+            cls = serialization.unpack(spec.fn_blob)
+            args, kwargs = self._resolve_args(spec)
+            instance = cls(*args, **kwargs)
+            rt = ActorRuntime(spec.actor_id, instance, spec.max_concurrency)
+            self.actors[spec.actor_id] = rt
+            return [None], None
+        except Exception as e:
+            err = TaskError(type(e).__name__, str(e), traceback.format_exc())
+            return [err], err
+
+    def _run_actor_task(self, rt: ActorRuntime, spec: TaskSpec):
+        self.current_task_id = spec.task_id
+        try:
+            method = getattr(rt.instance, spec.method_name)
+            args, kwargs = self._resolve_args(spec)
+            out = method(*args, **kwargs)
+            return self._split_returns(spec, out), None
+        except Exception as e:
+            err = TaskError(type(e).__name__, str(e), traceback.format_exc())
+            return [err] * max(1, spec.num_returns), err
+        finally:
+            self.current_task_id = None
+
+    @staticmethod
+    def _split_returns(spec: TaskSpec, out: Any) -> list:
+        n = spec.num_returns
+        if n == 0:
+            return []
+        if n == 1:
+            return [out]
+        if not isinstance(out, (tuple, list)) or len(out) != n:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={n} but returned "
+                f"{type(out).__name__} of length "
+                f"{len(out) if hasattr(out, '__len__') else 'n/a'}"
+            )
+        return list(out)
+
+    async def _store_returns(self, spec: TaskSpec, results: list):
+        """→ list of ("inline", bytes) | ("stored", None) per return slot."""
+        out = []
+        for obj_id, value in zip(spec.return_ids, results):
+            head, views = serialization.serialize(value)
+            size = serialization.serialized_size(head, views)
+            if size <= self.config.max_inline_object_size:
+                data = bytearray(size)
+                serialization.write_to(memoryview(data), head, views)
+                data = bytes(data)
+                await self.raylet.call("store_put_inline", {
+                    "object_id": obj_id, "data": data,
+                })
+                out.append(("inline", data))
+            else:
+                resp = await self.raylet.call("store_create", {
+                    "object_id": obj_id, "size": size,
+                })
+                from ray_tpu.core.object_store import attach_segment
+
+                view = attach_segment(resp["shm_name"], size)
+                serialization.write_to(view, head, views)
+                view.release()
+                await self.raylet.call("store_seal", {"object_id": obj_id})
+                out.append(("stored", None))
+        return out
+
+    async def run_forever(self) -> None:
+        await self._exit.wait()
+        try:
+            self.raylet.notify("worker_exiting", {"worker_id": self.worker_id})
+        except Exception:
+            pass
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--raylet", required=True)
+    ap.add_argument("--gcs", required=True)
+    ap.add_argument("--node-id", required=True)
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--session-dir", required=True)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="[worker] %(levelname)s %(message)s")
+    rhost, rport = args.raylet.rsplit(":", 1)
+    ghost, gport = args.gcs.rsplit(":", 1)
+    config = Config.from_env()
+
+    async def run():
+        worker = Worker(
+            WorkerID.from_hex(args.worker_id).binary(),
+            (rhost, int(rport)),
+            (ghost, int(gport)),
+            bytes.fromhex(args.node_id),
+            config,
+            args.session_dir,
+        )
+        await worker.start()
+        await worker.run_forever()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()
