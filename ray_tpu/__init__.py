@@ -10,8 +10,9 @@ from ray_tpu._version import __version__
 
 _API_EXPORTS = (
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
-    "available_resources", "ObjectRef", "get_runtime_context",
+    "free", "kill", "cancel", "get_actor", "method", "nodes",
+    "cluster_resources", "available_resources", "ObjectRef",
+    "get_runtime_context", "RayTaskError",
 )
 
 

@@ -316,11 +316,19 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         client = _ensure_client()
         o = self._options
+        placement = _build_resources(o)
+        # Reference semantics: actors use 1 CPU for scheduling but hold 0 CPU
+        # while alive unless num_cpus was explicit
+        # (ref: _private/ray_option_utils.py actor defaults).
+        hold = dict(placement)
+        if o.get("num_cpus") is None and "CPU" not in (o.get("resources") or {}):
+            hold["CPU"] = 0.0
         actor_id = client.create_actor(
             serialization.pack(self._cls),
             self._cls.__name__,
             args, kwargs,
-            resources=_build_resources(o),
+            resources=placement,
+            hold_resources=hold,
             max_restarts=o.get("max_restarts", 0),
             max_concurrency=o.get("max_concurrency", 1),
             actor_name=o.get("name"),

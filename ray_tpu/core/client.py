@@ -445,6 +445,7 @@ class CoreClient:
         kwargs: dict,
         *,
         resources: dict[str, float] | None = None,
+        hold_resources: dict[str, float] | None = None,
         max_restarts: int = 0,
         max_concurrency: int = 1,
         actor_name: str | None = None,
@@ -456,7 +457,7 @@ class CoreClient:
         st.resources = resources
         self._actors[actor_id] = st
         result = self._run(self._create_actor_async(
-            st, cls_blob, name, args, kwargs, resources,
+            st, cls_blob, name, args, kwargs, resources, hold_resources,
             max_restarts, max_concurrency, actor_name, get_if_exists,
         ))
         if isinstance(result, bytes):       # got existing named actor
@@ -464,7 +465,7 @@ class CoreClient:
         return actor_id
 
     async def _create_actor_async(
-        self, st, cls_blob, name, args, kwargs, resources,
+        self, st, cls_blob, name, args, kwargs, resources, hold_resources,
         max_restarts, max_concurrency, actor_name, get_if_exists,
     ):
         task_id = TaskID.for_actor_task(ActorID(st.actor_id))
@@ -480,6 +481,7 @@ class CoreClient:
             num_returns=1,
             return_ids=[ObjectID.for_return(task_id, 0).binary()],
             resources=resources,
+            hold_resources=hold_resources,
             actor_id=st.actor_id,
             max_restarts=max_restarts,
             max_concurrency=max_concurrency,
@@ -552,7 +554,10 @@ class CoreClient:
         await raylet.call("release_lease", {
             "worker_id": grant["worker_id"],
             "actor_id": st.actor_id,
-            "resources": spec.resources,
+            "resources": (
+                spec.resources if spec.hold_resources is None
+                else spec.hold_resources
+            ),
         })
         st.address = tuple(reply["actor_address"])
         st.conn = conn
@@ -614,18 +619,6 @@ class CoreClient:
         from ray_tpu.core.task_error import TaskError
 
         for attempt in range(100):
-            # Wait until the actor is ALIVE (creation/restart may be slow —
-            # bounded only by the lease timeout, not this loop).
-            try:
-                await asyncio.wait_for(
-                    st.ready.wait(), self.config.lease_timeout_s * 2
-                )
-            except asyncio.TimeoutError:
-                self._fail_returns(spec, TaskError(
-                    "ActorUnavailableError",
-                    "timed out waiting for actor to start", "",
-                ))
-                return
             if st.dead:
                 self._fail_returns(spec, TaskError(
                     "ActorDiedError",
@@ -633,17 +626,29 @@ class CoreClient:
                 ))
                 return
             if st.address is None:
-                # Another owner's actor: resolve via GCS.
+                # Resolve via GCS (covers actors created by other clients and
+                # events published before we subscribed).
                 info = await self.gcs.call("get_actor", {"actor_id": st.actor_id})
-                if info is None or info["state"] == "DEAD":
+                if info is not None and info["state"] == "DEAD":
                     st.dead = True
-                    st.death_cause = (info or {}).get("death_cause", "not found")
+                    st.death_cause = info.get("death_cause", "not found")
                     continue
-                if info["state"] == "ALIVE" and info["address"]:
+                if info is not None and info["state"] == "ALIVE" and info["address"]:
                     st.address = tuple(info["address"])
+                    st.ready.set()
                 else:
-                    st.ready.clear()
-                    await asyncio.sleep(0.05)
+                    # PENDING/RESTARTING (or our own creation in flight): wait
+                    # for the ALIVE/DEAD signal — pubsub or local _place_actor.
+                    try:
+                        await asyncio.wait_for(
+                            st.ready.wait(), self.config.lease_timeout_s * 2
+                        )
+                    except asyncio.TimeoutError:
+                        self._fail_returns(spec, TaskError(
+                            "ActorUnavailableError",
+                            "timed out waiting for actor to start", "",
+                        ))
+                        return
                     continue
             try:
                 conn = st.conn

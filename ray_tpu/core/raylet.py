@@ -348,8 +348,17 @@ class Raylet:
                 continue
             worker = self._find_idle_worker()
             if worker is None:
-                n_alive = len(self.workers)
-                if n_alive < self.config.max_workers_per_node:
+                # Spawn only up to the node's concurrency capacity: one slot
+                # per whole CPU plus actor-pinned workers (ref: worker_pool.cc
+                # maximum_startup_concurrency).
+                n_pinned = sum(
+                    1 for h in self.workers.values() if h.actor_id is not None
+                )
+                cap = min(
+                    int(self.resources_total.get("CPU", 1)) + n_pinned,
+                    self.config.max_workers_per_node,
+                )
+                if len(self.workers) < cap:
                     self._spawn_worker()
                 continue
             worker.idle = False

@@ -37,6 +37,7 @@ class TaskSpec:
     num_returns: int = 1
     return_ids: list[bytes] = field(default_factory=list)
     resources: dict[str, float] = field(default_factory=dict)
+    hold_resources: dict[str, float] | None = None  # actor lifetime holdings
     max_retries: int = 0
     retry_count: int = 0
     # actor fields

@@ -1,0 +1,225 @@
+"""Single-node integration tests for the tasks/actors/objects API.
+
+Mirrors the reference's `python/ray/tests/test_basic*.py` coverage: remote
+functions, options, multiple returns, object passing, actors, named actors,
+errors, wait, kill.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import api
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def plus_one(x):
+    return x + 1
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def get(self):
+        return self.n
+
+    def boom(self):
+        raise RuntimeError("actor error")
+
+
+def test_task_basic(cluster):
+    assert ray_tpu.get(plus_one.remote(1), timeout=30) == 2
+
+
+def test_task_kwargs_and_closure(cluster):
+    y = 100
+
+    @ray_tpu.remote
+    def f(a, b=10):
+        return a + b + y
+
+    assert ray_tpu.get(f.remote(1), timeout=30) == 111
+    assert ray_tpu.get(f.remote(1, b=20), timeout=30) == 121
+
+
+def test_many_parallel_tasks(cluster):
+    refs = [plus_one.remote(i) for i in range(50)]
+    assert sum(ray_tpu.get(refs, timeout=60)) == sum(range(1, 51))
+
+
+def test_num_returns(cluster):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c], timeout=30) == [1, 2, 3]
+
+
+def test_put_get_roundtrip(cluster):
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref, timeout=30) == {"k": [1, 2, 3]}
+
+
+def test_large_object_via_shm(cluster):
+    arr = np.random.default_rng(0).standard_normal(500_000)
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote
+    def mean(a):
+        return float(a.mean())
+
+    assert abs(ray_tpu.get(mean.remote(ref), timeout=30) - arr.mean()) < 1e-12
+
+
+def test_large_task_return(cluster):
+    @ray_tpu.remote
+    def big():
+        return np.ones(300_000)
+
+    out = ray_tpu.get(big.remote(), timeout=30)
+    assert out.shape == (300_000,)
+    assert out.sum() == 300_000
+
+
+def test_object_ref_args_chain(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    r = add.remote(plus_one.remote(1), plus_one.remote(2))
+    assert ray_tpu.get(r, timeout=30) == 5
+
+
+def test_error_propagation(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("xyz")
+
+    with pytest.raises(api.RayTaskError) as ei:
+        ray_tpu.get(boom.remote(), timeout=30)
+    assert ei.value.exc_type == "ValueError"
+    assert "xyz" in str(ei.value)
+
+
+def test_error_through_dependency(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("dep failed")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(api.RayTaskError):
+        ray_tpu.get(consume.remote(boom.remote()), timeout=30)
+
+
+def test_wait(cluster):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.05), slow.remote(10)]
+    ready, pending = ray_tpu.wait(refs, num_returns=1, timeout=5)
+    assert len(ready) == 1 and len(pending) == 1
+    assert ray_tpu.get(ready[0], timeout=10) == 0.05
+
+
+def test_nested_tasks(cluster):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rt
+
+        return rt.get(inner.remote(x), timeout=30) + 1
+
+    assert ray_tpu.get(outer.remote(10), timeout=60) == 21
+
+
+def test_actor_basic(cluster):
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.incr.remote(), timeout=30) == 6
+    assert ray_tpu.get(c.incr.remote(10), timeout=30) == 16
+    assert ray_tpu.get(c.get.remote(), timeout=30) == 16
+
+
+def test_actor_method_ordering(cluster):
+    c = Counter.remote(0)
+    refs = [c.incr.remote() for _ in range(20)]
+    vals = ray_tpu.get(refs, timeout=30)
+    assert vals == list(range(1, 21))
+
+
+def test_actor_error(cluster):
+    c = Counter.remote(0)
+    with pytest.raises(api.RayTaskError):
+        ray_tpu.get(c.boom.remote(), timeout=30)
+    # actor survives method errors
+    assert ray_tpu.get(c.incr.remote(), timeout=30) == 1
+
+
+def test_actor_handle_passing(cluster):
+    c = Counter.remote(0)
+
+    @ray_tpu.remote
+    def use_actor(h):
+        import ray_tpu as rt
+
+        return rt.get(h.incr.remote(7), timeout=30)
+
+    assert ray_tpu.get(use_actor.remote(c), timeout=60) == 7
+
+
+def test_named_actor(cluster):
+    Counter.options(name="named-1").remote(42)
+    h = ray_tpu.get_actor("named-1")
+    assert ray_tpu.get(h.get.remote(), timeout=30) == 42
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does-not-exist")
+
+
+def test_kill_actor(cluster):
+    c = Counter.options(name="to-kill").remote(0)
+    assert ray_tpu.get(c.get.remote(), timeout=30) == 0
+    ray_tpu.kill(c)
+    time.sleep(0.3)
+    with pytest.raises(api.RayTaskError):
+        ray_tpu.get(c.get.remote(), timeout=10)
+
+
+def test_options_validation(cluster):
+    with pytest.raises(ValueError):
+        plus_one.options(bogus=1)
+    with pytest.raises(TypeError):
+        plus_one(1)  # direct call forbidden
+
+
+def test_cluster_resources(cluster):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 4
+
+
+def test_free(cluster):
+    ref = ray_tpu.put(np.ones(200_000))
+    assert ray_tpu.get(ref, timeout=10) is not None
+    ray_tpu.free([ref])
