@@ -39,6 +39,10 @@ class GetTimeoutError(TimeoutError):
     pass
 
 
+class _PlacementRetry(Exception):
+    """Placement attempt failed but the actor remains RESTARTING."""
+
+
 class ActorDiedError(RuntimeError):
     pass
 
@@ -54,6 +58,7 @@ class ActorState:
         self.resources: dict[str, float] = {}
         self.ready = asyncio.Event()   # set when ALIVE (or DEAD — check .dead)
         self.restarting = False
+        self._restart_driver = None
 
 
 class CoreClient:
@@ -531,11 +536,16 @@ class CoreClient:
         except Exception as e:
             from ray_tpu.core.task_error import TaskError
 
-            await self.gcs.call("actor_failed", {
+            resp = await self.gcs.call("actor_failed", {
                 "actor_id": st.actor_id,
                 "error": f"placement failed: {e}",
                 "resources": spec.resources,
+                "placement_failed": True,
             })
+            if resp.get("restart"):
+                # stays RESTARTING; the restart driver / next actor-task
+                # submission re-places (possibly on a different node)
+                raise _PlacementRetry(str(e))
             st.dead = True
             st.death_cause = str(e)
             st.ready.set()
@@ -639,6 +649,11 @@ class CoreClient:
                 else:
                     # PENDING/RESTARTING (or our own creation in flight): wait
                     # for the ALIVE/DEAD signal — pubsub or local _place_actor.
+                    # If it's RESTARTING with no one driving placement (e.g.
+                    # node died while idle), drive it ourselves.
+                    if info is not None and info["state"] == "RESTARTING":
+                        asyncio.ensure_future(self._ensure_actor_restart(
+                            st, "observed RESTARTING"))
                     try:
                         await asyncio.wait_for(
                             st.ready.wait(), self.config.lease_timeout_s * 2
@@ -666,29 +681,65 @@ class CoreClient:
                 self._record_returns(spec, reply)
                 return
             except (rpc.ConnectionLost, rpc.RpcError) as e:
-                # Actor worker died: ask GCS about restart
-                # (ref: direct_actor_task_submitter.cc DisconnectActor).
+                # Actor worker died. Drive the restart in the background, but
+                # do NOT resubmit this task unless it opted into retries —
+                # it may have partially executed (ref: max_task_retries=0
+                # default, direct_actor_task_submitter.cc DisconnectActor).
                 st.address = None
                 st.conn = None
                 st.ready.clear()
-                resp = await self.gcs.call("actor_failed", {
-                    "actor_id": st.actor_id,
-                    "error": str(e),
-                    "resources": st.resources,
-                })
-                if resp.get("restart"):
-                    await self._restart_actor(
-                        st, tuple(resp["node_address"]), resp.get("node_id", b"")
-                    )
+                asyncio.ensure_future(self._ensure_actor_restart(st, str(e)))
+                if spec.max_retries > 0:
+                    spec.max_retries -= 1
                     continue
-                st.dead = True
-                st.death_cause = str(e)
-                st.ready.set()
+                self._fail_returns(spec, TaskError(
+                    "ActorDiedError",
+                    f"actor died while executing {spec.name}: {e}", "",
+                ))
+                return
         self._fail_returns(spec, TaskError(
             "ActorUnavailableError", "actor task retry budget exhausted", "",
         ))
 
-    _restart_locks: dict | None = None
+    async def _ensure_actor_restart(self, st: ActorState, error: str) -> None:
+        """Report the failure and drive re-placement until the actor is ALIVE
+        again or declared DEAD. Safe to call concurrently — the GCS `placing`
+        guard serializes actual placement, and only one driver runs per
+        client (st._restart_driver)."""
+        if getattr(st, "_restart_driver", None) is not None:
+            return
+        st._restart_driver = True
+        try:
+            for _ in range(600):
+                if st.dead or (st.address is not None and st.ready.is_set()):
+                    return
+                try:
+                    resp = await self.gcs.call("actor_failed", {
+                        "actor_id": st.actor_id,
+                        "error": error,
+                        "resources": st.resources,
+                    })
+                except rpc.ConnectionLost:
+                    return
+                if not resp.get("restart"):
+                    st.dead = True
+                    st.death_cause = resp.get("cause", error)
+                    st.ready.set()
+                    return
+                if resp.get("wait") or resp.get("node_id") is None:
+                    await asyncio.sleep(0.3)
+                    continue
+                try:
+                    await self._restart_actor(
+                        st, tuple(resp["node_address"]),
+                        resp.get("node_id", b""),
+                    )
+                except _PlacementRetry:
+                    await asyncio.sleep(0.3)
+                    continue
+                return
+        finally:
+            st._restart_driver = None
 
     async def _restart_actor(self, st: ActorState, node_address,
                              node_id: bytes = b"") -> None:
@@ -709,6 +760,8 @@ class CoreClient:
         st.dead = False
         try:
             await self._place_actor(st, spec, node_address, node_id)
+        except _PlacementRetry:
+            raise
         except Exception as e:
             logger.warning("actor restart failed: %s", e)
 

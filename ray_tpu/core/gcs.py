@@ -52,6 +52,9 @@ class ActorInfo:
     create_spec: bytes | None = None          # serialized creation task
     owner_address: tuple[str, int] | None = None
     death_cause: str | None = None
+    resources: dict[str, float] = field(default_factory=dict)
+    placing: bool = False                     # a client is driving placement
+    placing_since: float = 0.0
 
 
 class GcsServer:
@@ -195,6 +198,7 @@ class GcsServer:
             max_restarts=p.get("max_restarts", 0),
             create_spec=p.get("create_spec"),
             owner_address=tuple(p["owner_address"]) if p.get("owner_address") else None,
+            resources=dict(p.get("resources", {})),
         )
         self.actors[actor_id] = info
         if p.get("create_spec") is not None:
@@ -237,30 +241,68 @@ class GcsServer:
         info = self.actors[p["actor_id"]]
         info.state = ALIVE
         info.address = tuple(p["address"])
-        info.node_id = p["node_id"]
+        info.placing = False
+        if p.get("node_id"):
+            info.node_id = p["node_id"]
         self.publish("actor", {"actor_id": p["actor_id"], "state": ALIVE,
                                "address": info.address})
         return {"ok": True}
 
     async def _actor_failed(self, conn, p):
+        """Actor worker died. FSM (ref: gcs_actor_manager.cc:1068-1079):
+        - restarts left → RESTARTING; stay RESTARTING even with no feasible
+          node (waits for one); exactly one client drives the placement
+          (`placing` guard, re-armable after a timeout in case that client
+          died mid-placement).
+        - budget exhausted → DEAD, broadcast."""
         info = self.actors.get(p["actor_id"])
         if info is None or info.state == DEAD:
-            return {"ok": True, "restart": False}
-        if info.max_restarts == -1 or info.num_restarts < info.max_restarts:
+            return {"ok": True, "restart": False,
+                    "cause": info.death_cause if info else "unknown actor"}
+        if info.state != RESTARTING:
+            allowed = (
+                info.max_restarts == -1
+                or info.num_restarts < info.max_restarts
+            )
+            if not allowed:
+                info.state = DEAD
+                info.death_cause = p.get("error", "worker died")
+                if info.name:
+                    self.named_actors.pop(info.name, None)
+                self.publish("actor", {"actor_id": p["actor_id"], "state": DEAD,
+                                       "cause": info.death_cause})
+                return {"ok": True, "restart": False, "cause": info.death_cause}
             info.num_restarts += 1
             info.state = RESTARTING
-            self.publish("actor", {"actor_id": p["actor_id"], "state": RESTARTING})
-            node = self._schedule_actor(p.get("resources", {}))
-            if node is not None:
-                info.node_id = node.node_id
-                return {"ok": True, "restart": True,
-                        "node_id": node.node_id, "node_address": node.address,
-                        "num_restarts": info.num_restarts}
-        info.state = DEAD
-        info.death_cause = p.get("error", "worker died")
-        self.publish("actor", {"actor_id": p["actor_id"], "state": DEAD,
-                               "cause": info.death_cause})
-        return {"ok": True, "restart": False}
+            info.address = None
+            info.placing = False
+            self.publish("actor", {"actor_id": p["actor_id"],
+                                   "state": RESTARTING})
+        if p.get("transition_only"):
+            # node-death sweep: flip state; owners drive placement when they
+            # next touch the actor
+            return {"ok": True, "restart": True, "node_id": None}
+        if p.get("placement_failed"):
+            # the caller held the placement slot and failed — release it so
+            # the next attempt can claim a (possibly different) node
+            info.placing = False
+        if info.placing and (
+            time.monotonic() - info.placing_since
+            < self.config.lease_timeout_s
+        ):
+            return {"ok": True, "restart": True, "wait": True}
+        node = self._schedule_actor(info.resources)
+        if node is None:
+            # No feasible node right now — caller retries; actor stays
+            # RESTARTING until a node joins or the caller gives up.
+            return {"ok": True, "restart": True, "node_id": None}
+        info.node_id = node.node_id
+        info.placing = True
+        info.placing_since = time.monotonic()
+        self._deduct(node, info.resources)
+        return {"ok": True, "restart": True,
+                "node_id": node.node_id, "node_address": node.address,
+                "num_restarts": info.num_restarts}
 
     async def _kill_actor(self, conn, p):
         info = self.actors.get(p["actor_id"])
@@ -349,7 +391,8 @@ class GcsServer:
             if info_a.node_id == node_id and info_a.state in (ALIVE, PENDING):
                 asyncio.ensure_future(
                     self._actor_failed(None, {"actor_id": info_a.actor_id,
-                                              "error": f"node died ({why})"})
+                                              "error": f"node died ({why})",
+                                              "transition_only": True})
                 )
 
     async def _health_loop(self) -> None:

@@ -1,0 +1,202 @@
+"""Multi-node tests: spillback scheduling, object transfer, fault tolerance.
+
+Mirrors the reference's multi-node-without-a-cluster approach
+(`/root/reference/python/ray/tests/test_multi_node*.py` +
+`cluster_utils.py:99`): several raylet processes on one machine, one GCS.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import api
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def two_node_cluster():
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"special": 1})
+    ray_tpu.init(address=cluster.address)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_spillback_to_remote_node(two_node_cluster):
+    """Tasks needing a resource only the second node has must spill there."""
+
+    @ray_tpu.remote(resources={"special": 0.1})
+    def where():
+        import os
+
+        return os.getpid()
+
+    pids = set(ray_tpu.get([where.remote() for _ in range(4)], timeout=60))
+    assert len(pids) >= 1  # ran somewhere — on the special node
+
+    @ray_tpu.remote
+    def anywhere():
+        import os
+
+        return os.getpid()
+
+    all_pids = set(ray_tpu.get([anywhere.remote() for _ in range(8)], timeout=60))
+    assert not pids & all_pids or len(all_pids) > 1
+
+
+def test_infeasible_task_errors(two_node_cluster):
+    @ray_tpu.remote(resources={"nonexistent": 1})
+    def f():
+        return 1
+
+    with pytest.raises(api.RayTaskError):
+        ray_tpu.get(f.remote(), timeout=30)
+
+
+def test_object_transfer_between_nodes(two_node_cluster):
+    """A large object produced on node B must be pullable from node A."""
+
+    @ray_tpu.remote(resources={"special": 0.1})
+    def produce():
+        return np.arange(500_000, dtype=np.float64)
+
+    ref = produce.remote()
+    out = ray_tpu.get(ref, timeout=60)   # driver is on head node → pull
+    np.testing.assert_array_equal(out[:5], [0, 1, 2, 3, 4])
+    assert out.shape == (500_000,)
+
+
+def test_cluster_resources_aggregate(two_node_cluster):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4
+    assert total["special"] == 1
+    assert len(ray_tpu.nodes()) == 2
+
+
+def test_task_retry_on_worker_crash(two_node_cluster):
+    """A task that kills its worker on first attempt succeeds via retry
+    (ref: task_manager.h retries)."""
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(path):
+        import os
+
+        if not os.path.exists(path):
+            open(path, "w").write("x")
+            os._exit(1)  # simulate worker crash
+        return "survived"
+
+    import tempfile
+
+    path = tempfile.mktemp()
+    assert ray_tpu.get(flaky.remote(path), timeout=60) == "survived"
+
+
+def test_task_failure_after_retries_exhausted(two_node_cluster):
+    @ray_tpu.remote(max_retries=1)
+    def always_dies():
+        import os
+
+        os._exit(1)
+
+    with pytest.raises(api.RayTaskError) as ei:
+        ray_tpu.get(always_dies.remote(), timeout=60)
+    assert "WorkerCrashed" in ei.value.exc_type
+
+
+def test_actor_restart(two_node_cluster):
+    """max_restarts>0: actor comes back after its process dies
+    (ref: gcs_actor_manager.cc:1068-1079)."""
+
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote(), timeout=30) == 1
+    p.die.remote()
+    time.sleep(1.0)
+    # restarted: state reset (fresh __init__), but alive
+    out = ray_tpu.get(p.incr.remote(), timeout=60)
+    assert out == 1
+
+
+def test_actor_no_restart_death(two_node_cluster):
+    @ray_tpu.remote
+    class Mortal:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    m = Mortal.remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=30) == "pong"
+    m.die.remote()
+    time.sleep(0.5)
+    with pytest.raises(api.RayTaskError):
+        ray_tpu.get(m.ping.remote(), timeout=30)
+
+
+def test_node_death_detection(two_node_cluster):
+    """Killing a node flips it dead in the cluster view
+    (ref: gcs_heartbeat_manager.cc death detection)."""
+    cluster = two_node_cluster
+    node = cluster.worker_nodes[0]
+    assert sum(1 for n in ray_tpu.nodes() if n["Alive"]) == 2
+    cluster.remove_node(node)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        alive = sum(1 for n in ray_tpu.nodes() if n["Alive"])
+        if alive == 1:
+            break
+        time.sleep(0.5)
+    assert sum(1 for n in ray_tpu.nodes() if n["Alive"]) == 1
+
+
+def test_actor_failover_on_node_death():
+    """A restartable actor on a dying node is rescheduled elsewhere."""
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    node2 = cluster.add_node(num_cpus=2, resources={"pin": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(max_restarts=-1, resources={"pin": 0.1})
+        class Survivor:
+            def ping(self):
+                return "pong"
+
+        s = Survivor.remote()
+        assert ray_tpu.get(s.ping.remote(), timeout=60) == "pong"
+        # Node 2 dies; pin resource is gone, but CPU-only restart can land on
+        # the head node once the failed-actor reschedule drops... it can't —
+        # pin exists only on node2. Add a new node with the resource:
+        cluster.remove_node(node2)
+        cluster.add_node(num_cpus=2, resources={"pin": 1})
+        deadline = time.time() + 60
+        ok = False
+        while time.time() < deadline:
+            try:
+                assert ray_tpu.get(s.ping.remote(), timeout=30) == "pong"
+                ok = True
+                break
+            except api.RayTaskError:
+                time.sleep(1)
+        assert ok, "actor did not fail over to the replacement node"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
