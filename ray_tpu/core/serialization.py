@@ -42,10 +42,45 @@ def _to_host(obj: Any) -> Any:
     return obj
 
 
+_BY_VALUE_REGISTERED: set[str] = set()
+
+
+def _ensure_by_value(obj: Any) -> None:
+    """Driver-local modules (scripts, tests) aren't importable in workers —
+    register them with cloudpickle so their functions/classes serialize by
+    value (parity with shipping driver code; the reference solves this with
+    runtime_env working_dir upload, runtime_env/packaging.py)."""
+    import sys
+    import sysconfig
+
+    mod_name = getattr(obj, "__module__", None)
+    if (
+        not mod_name
+        or mod_name in _BY_VALUE_REGISTERED
+        or mod_name == "__main__"
+        or mod_name.split(".")[0] == "ray_tpu"
+    ):
+        return
+    mod = sys.modules.get(mod_name)
+    f = getattr(mod, "__file__", None) if mod else None
+    if not f:
+        return
+    paths = sysconfig.get_paths()
+    if f.startswith(paths["stdlib"]) or f.startswith(paths["purelib"]):
+        return
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+        _BY_VALUE_REGISTERED.add(mod_name)
+    except Exception:
+        pass
+
+
 def serialize(value: Any) -> tuple[bytes, list[memoryview]]:
     """Returns (header+pickle bytes, out-of-band buffers)."""
     buffers: list[pickle.PickleBuffer] = []
     value = _to_host(value)
+    if callable(value) or isinstance(value, type):
+        _ensure_by_value(value)
     payload = cloudpickle.dumps(
         value, protocol=5, buffer_callback=buffers.append
     )

@@ -1,1 +1,19 @@
+"""Train: distributed SPMD training on TPU (Ray Train capability parity)."""
 
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackend, JaxBackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.trainer import JaxTrainer, TrainingFailedError
+from ray_tpu.train import session
+
+__all__ = [
+    "Backend", "BackendConfig", "JaxBackend", "JaxBackendConfig",
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "Result", "RunConfig",
+    "ScalingConfig", "JaxTrainer", "TrainingFailedError", "session",
+]

@@ -1,0 +1,80 @@
+"""Collective backend setup for train workers.
+
+Parity: `/root/reference/python/ray/train/backend.py:55,68` (Backend.on_start)
+and `train/torch/config.py:120-174` (_TorchBackend → init_process_group
+NCCL/Gloo). TPU-native: the process group IS `jax.distributed` — on TPU pods
+each worker-host calls jax.distributed.initialize() and ICI collectives are
+compiled into programs; on CPU (tests) the gloo cross-process backend gives
+real multi-process collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+from typing import Any
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks run around the worker group lifecycle."""
+
+    def on_start(self, worker_group, backend_config) -> None:  # noqa: ARG002
+        pass
+
+    def on_shutdown(self, worker_group, backend_config) -> None:  # noqa: ARG002
+        pass
+
+
+@dataclasses.dataclass
+class JaxBackendConfig(BackendConfig):
+    platform: str | None = None        # None=auto, "cpu" forces CPU (tests)
+    coordinator_port: int | None = None
+    cpu_collectives: str = "gloo"
+    init_distributed: bool = True      # False for single-worker local mode
+    devices_per_worker: int = 1        # virtual CPU devices per worker (tests)
+
+    def backend_cls(self):
+        return JaxBackend
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxBackendConfig) -> None:
+        n = len(worker_group)
+        if not backend_config.init_distributed or n == 0:
+            worker_group.run_on_all(
+                "setup_jax",
+                platform=backend_config.platform,
+                coordinator=None, world_size=n,
+                devices_per_worker=backend_config.devices_per_worker,
+            )
+            return
+        port = backend_config.coordinator_port or find_free_port()
+        coordinator = f"127.0.0.1:{port}"
+        # All workers must call initialize() concurrently (it barriers), so
+        # fire the actor tasks without waiting in between.
+        worker_group.run_on_all(
+            "setup_jax",
+            platform=backend_config.platform,
+            coordinator=coordinator,
+            world_size=n,
+            cpu_collectives=backend_config.cpu_collectives,
+            devices_per_worker=backend_config.devices_per_worker,
+        )
+
+    def on_shutdown(self, worker_group, backend_config) -> None:
+        try:
+            worker_group.run_on_all("teardown_jax")
+        except Exception:
+            pass

@@ -1,0 +1,91 @@
+"""AIR-style Checkpoint: dict ↔ directory ↔ object-ref interconvertible.
+
+Parity: `/root/reference/python/ray/air/checkpoint.py:61`. TPU-first notes:
+`from_params/to_params` handle jax pytrees (host-transferred, optionally via
+orbax for large sharded params — each host saves its addressable shards).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any
+
+
+class Checkpoint:
+    def __init__(self, data: dict | None = None, path: str | None = None):
+        if (data is None) == (path is None):
+            raise ValueError("exactly one of data/path required")
+        self._data = data
+        self._path = path
+
+    # ---- constructors ----
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=path)
+
+    @classmethod
+    def from_params(cls, params: Any, **extra) -> "Checkpoint":
+        """Host-transfer a jax pytree and wrap it."""
+        import jax
+        import numpy as np
+
+        host = jax.tree.map(lambda x: np.asarray(x), params)
+        return cls(data={"params": host, **extra})
+
+    # ---- accessors ----
+
+    def to_dict(self) -> dict:
+        if self._data is not None:
+            return self._data
+        with open(os.path.join(self._path, "checkpoint.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, path: str | None = None) -> str:
+        if path is None:
+            path = os.path.join(
+                tempfile.gettempdir(), f"raytpu-ckpt-{uuid.uuid4().hex[:8]}"
+            )
+        os.makedirs(path, exist_ok=True)
+        if self._path is not None:
+            if os.path.abspath(self._path) != os.path.abspath(path):
+                shutil.copytree(self._path, path, dirs_exist_ok=True)
+        else:
+            with open(os.path.join(path, "checkpoint.pkl"), "wb") as f:
+                pickle.dump(self._data, f, protocol=5)
+        return path
+
+    def to_params(self) -> Any:
+        return self.to_dict()["params"]
+
+    def __getitem__(self, k):
+        return self.to_dict()[k]
+
+    def get(self, k, default=None):
+        return self.to_dict().get(k, default)
+
+
+def save_sharded(params: Any, path: str) -> None:
+    """Orbax-backed sharded save: on a multi-host mesh every process writes
+    its addressable shards (ref capability: Train checkpoint streaming,
+    train/_internal/checkpoint.py)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_sharded(path: str, abstract_tree: Any) -> Any:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path), abstract_tree)

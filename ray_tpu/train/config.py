@@ -1,0 +1,58 @@
+"""Train/AIR config objects.
+
+Parity: `/root/reference/python/ray/air/config.py:79,452,511,640`
+(ScalingConfig / FailureConfig / CheckpointConfig / RunConfig).
+TPU-first: `use_tpu` + `topology` replace `use_gpu`; a worker is a *host*
+owning all its local chips (SPMD inside, actors across hosts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: dict[str, float] | None = None
+    topology: str | None = None          # e.g. "v5e-8" (slice gang hint)
+    placement_strategy: str = "PACK"
+
+    @property
+    def _resources(self) -> dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        return {"CPU": 1, "TPU": 4} if self.use_tpu else {"CPU": 1}
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig
+    )
+    verbose: int = 0
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: dict[str, Any] | None
+    checkpoint: Any | None
+    error: Exception | None = None
+    metrics_history: list[dict[str, Any]] = dataclasses.field(default_factory=list)

@@ -1,0 +1,164 @@
+"""WorkerGroup: gang of train-worker actors.
+
+Parity: `/root/reference/python/ray/train/_internal/worker_group.py` +
+`backend_executor.py`. Each worker is an actor hosting one training process
+(= one TPU host in pod mode); the train fn runs on a background thread so the
+actor stays responsive to poll() for streamed metrics (the reference streams
+through a result queue).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any
+
+import ray_tpu
+from ray_tpu.core import serialization
+
+
+class TrainWorker:
+    """Actor hosting one training process."""
+
+    def __init__(self, rank: int, world_size: int, env_vars: dict | None = None):
+        self.rank = rank
+        self.world_size = world_size
+        for k, v in (env_vars or {}).items():
+            os.environ[k] = v
+        self.session = None
+        self.thread: threading.Thread | None = None
+        self._done = False
+        self._error: str | None = None
+        self._result: Any = None
+
+    # ---- backend hooks ----
+
+    def setup_jax(self, platform=None, coordinator=None, world_size=1,
+                  cpu_collectives="gloo", devices_per_worker=1):
+        if platform:
+            os.environ["JAX_PLATFORMS"] = platform
+        if platform == "cpu":
+            # Pin this worker's device count — never inherit the driver's
+            # XLA_FLAGS (e.g. the test harness forces 8 virtual devices).
+            import re
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "", flags
+            )
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={devices_per_worker}"
+            ).strip()
+        import jax
+
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        if coordinator and world_size > 1:
+            if (platform or "").startswith("cpu"):
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", cpu_collectives
+                    )
+                except Exception:
+                    pass
+            jax.distributed.initialize(
+                coordinator, num_processes=world_size, process_id=self.rank
+            )
+        return {"rank": self.rank, "devices": len(jax.devices()),
+                "local_devices": len(jax.local_devices())}
+
+    def teardown_jax(self):
+        try:
+            import jax
+
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        return True
+
+    # ---- training ----
+
+    def run_train_fn(self, fn_blob: bytes, config: dict,
+                     dataset_shards: dict | None = None) -> bool:
+        from ray_tpu.train.session import TrainSession, _set_session
+
+        fn = serialization.unpack(fn_blob)
+        self.session = TrainSession(
+            self.rank, self.world_size, dataset_shards=dataset_shards
+        )
+        self._done = False
+        self._error = None
+
+        def runner():
+            from ray_tpu.train import session as session_mod
+
+            session_mod._set_session(self.session)
+            try:
+                import inspect
+
+                takes_config = bool(
+                    inspect.signature(fn).parameters
+                )
+                if takes_config:
+                    self._result = fn(config or {})
+                else:
+                    self._result = fn()
+            except BaseException:
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+
+        self.thread = threading.Thread(target=runner, daemon=True)
+        self.thread.start()
+        return True
+
+    def poll(self) -> dict:
+        reports = self.session.drain() if self.session else []
+        out = {"reports": reports, "done": self._done, "error": self._error}
+        if self._done and self.session and self.session.latest_checkpoint:
+            out["checkpoint"] = self.session.latest_checkpoint
+        return out
+
+    def get_result(self):
+        return self._result
+
+    def get_checkpoint(self):
+        return self.session.latest_checkpoint if self.session else None
+
+    def shutdown(self):
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: dict[str, float],
+                 env_vars: dict | None = None, max_restarts: int = 0):
+        actor_cls = ray_tpu.remote(TrainWorker).options(
+            resources=resources_per_worker, max_restarts=max_restarts,
+            max_concurrency=4,   # poll() must interleave with run_train_fn
+        )
+        self.workers = [
+            actor_cls.remote(rank, num_workers, env_vars)
+            for rank in range(num_workers)
+        ]
+
+    def __len__(self):
+        return len(self.workers)
+
+    def run_on_all(self, method: str, *args, timeout: float | None = 300, **kw):
+        refs = [getattr(w, method).remote(*args, **kw) for w in self.workers]
+        return ray_tpu.get(refs, timeout=timeout)
+
+    def run_on_rank(self, rank: int, method: str, *args, timeout=300, **kw):
+        return ray_tpu.get(
+            getattr(self.workers[rank], method).remote(*args, **kw),
+            timeout=timeout,
+        )
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
