@@ -1,1 +1,18 @@
+"""Data: distributed datasets on the object store (Ray Data parity)."""
 
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "Dataset", "GroupedData", "from_arrow", "from_items", "from_numpy",
+    "from_pandas", "range", "read_csv", "read_json", "read_parquet",
+]

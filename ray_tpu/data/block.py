@@ -1,0 +1,132 @@
+"""Block accessors.
+
+Parity: `/root/reference/python/ray/data/block.py` + `_internal/arrow_block.py`
+/ `simple_block.py`. A block is either a pyarrow.Table (tabular rows) or a
+plain python list (simple block). Batches surface as dict[str, np.ndarray]
+("numpy", the TPU feed format), pandas, or arrow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+import pyarrow as pa
+
+Block = Any  # pa.Table | list
+
+
+def build_block(rows: list) -> Block:
+    """Rows of dicts → arrow table; anything else → simple list block."""
+    if rows and all(isinstance(r, dict) for r in rows):
+        cols = {k: [r.get(k) for r in rows] for k in rows[0]}
+        try:
+            return pa.table(cols)
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            return list(rows)
+    return list(rows)
+
+
+def from_batch(batch: Any) -> Block:
+    """A user-returned batch → block."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        cols = {}
+        for k, v in batch.items():
+            if isinstance(v, (list, pa.Array, pa.ChunkedArray)):
+                cols[k] = v
+            else:
+                arr = np.asarray(v)
+                # multi-dim columns become arrow lists (tensor-ish columns)
+                cols[k] = list(arr) if arr.ndim > 1 else arr
+        return pa.table(cols)
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return build_block(batch)
+    if isinstance(batch, np.ndarray):
+        return pa.table({"data": list(batch)})
+    raise TypeError(f"cannot convert {type(batch)} to a block")
+
+
+def num_rows(block: Block) -> int:
+    if isinstance(block, pa.Table):
+        return block.num_rows
+    return len(block)
+
+
+def size_bytes(block: Block) -> int:
+    if isinstance(block, pa.Table):
+        return block.nbytes
+    import sys
+
+    return sum(sys.getsizeof(x) for x in block)
+
+
+def to_rows(block: Block) -> list:
+    if isinstance(block, pa.Table):
+        return block.to_pylist()
+    return list(block)
+
+
+def to_batch(block: Block, batch_format: str = "numpy") -> Any:
+    if batch_format == "arrow":
+        return block if isinstance(block, pa.Table) else from_batch(block)
+    if batch_format == "pandas":
+        t = block if isinstance(block, pa.Table) else from_batch(block)
+        return t.to_pandas()
+    if batch_format == "numpy":
+        if isinstance(block, pa.Table):
+            out = {}
+            for name in block.column_names:
+                col = block.column(name)
+                try:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+                except (pa.ArrowInvalid, NotImplementedError):
+                    out[name] = np.asarray(col.to_pylist(), dtype=object)
+            return out
+        return np.asarray(block)
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    if isinstance(block, pa.Table):
+        return block.slice(start, end - start)
+    return block[start:end]
+
+
+def concat_blocks(blocks: list[Block]) -> Block:
+    tables = [b for b in blocks if isinstance(b, pa.Table)]
+    if len(tables) == len(blocks) and tables:
+        return pa.concat_tables(tables, promote_options="default")
+    out: list = []
+    for b in blocks:
+        out.extend(to_rows(b))
+    return build_block(out)
+
+
+def empty_like(block: Block) -> Block:
+    if isinstance(block, pa.Table):
+        return block.slice(0, 0)
+    return []
+
+
+def sort_block(block: Block, key: str | None, descending: bool = False) -> Block:
+    if isinstance(block, pa.Table):
+        assert key is not None, "tabular sort needs a key column"
+        order = "descending" if descending else "ascending"
+        return block.sort_by([(key, order)])
+    return sorted(block, reverse=descending)
+
+
+def key_values(block: Block, key: str | None) -> np.ndarray:
+    if isinstance(block, pa.Table):
+        assert key is not None
+        return block.column(key).to_numpy(zero_copy_only=False)
+    return np.asarray(list(block))

@@ -1,0 +1,105 @@
+"""Dataset constructors / IO.
+
+Parity: `/root/reference/python/ray/data/read_api.py` (range, from_items,
+from_numpy, from_pandas, read_parquet/csv/json).
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob as globlib
+import os
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+from ray_tpu.data.dataset import Dataset, from_items_local
+
+
+def from_items(items: list, *, parallelism: int = 4) -> Dataset:
+    return from_items_local(items, parallelism)
+
+
+def range(n: int, *, parallelism: int = 4) -> Dataset:  # noqa: A001
+    items = [{"id": i} for i in builtins.range(n)]
+    return from_items_local(items, parallelism)
+
+
+def from_numpy(arr: np.ndarray, *, parallelism: int = 4) -> Dataset:
+    chunks = np.array_split(arr, max(1, parallelism))
+    refs = [
+        ray_tpu.put(B.from_batch({"data": c})) for c in chunks if len(c)
+    ]
+    return Dataset(refs or [ray_tpu.put(B.build_block([]))], [])
+
+
+def from_pandas(df, *, parallelism: int = 4) -> Dataset:
+    import pyarrow as pa
+
+    n = max(1, parallelism)
+    rows = len(df)
+    chunk = (rows + n - 1) // n if rows else 1
+    refs = []
+    for i in builtins.range(0, rows, chunk):
+        refs.append(ray_tpu.put(
+            pa.Table.from_pandas(df.iloc[i:i + chunk], preserve_index=False)
+        ))
+    return Dataset(refs or [ray_tpu.put(B.build_block([]))], [])
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset([ray_tpu.put(table)], [])
+
+
+def _expand_paths(paths: str | list[str], suffix: str) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(globlib.glob(os.path.join(p, f"*{suffix}"))))
+        elif "*" in p:
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files found for {paths}")
+    return out
+
+
+@ray_tpu.remote
+def _read_parquet_task(path):
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path)
+
+
+@ray_tpu.remote
+def _read_csv_task(path):
+    import pyarrow.csv as pacsv
+
+    return pacsv.read_csv(path)
+
+
+@ray_tpu.remote
+def _read_json_task(path):
+    import pyarrow.json as pajson
+
+    return pajson.read_json(path)
+
+
+def read_parquet(paths: str | list[str]) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+    return Dataset([_read_parquet_task.remote(f) for f in files], [])
+
+
+def read_csv(paths: str | list[str]) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+    return Dataset([_read_csv_task.remote(f) for f in files], [])
+
+
+def read_json(paths: str | list[str]) -> Dataset:
+    files = _expand_paths(paths, ".json")
+    return Dataset([_read_json_task.remote(f) for f in files], [])
