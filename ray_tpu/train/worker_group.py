@@ -81,13 +81,18 @@ class TrainWorker:
     # ---- training ----
 
     def run_train_fn(self, fn_blob: bytes, config: dict,
-                     dataset_shards: dict | None = None) -> bool:
+                     dataset_shards: dict | None = None,
+                     initial_checkpoint=None) -> bool:
         from ray_tpu.train.session import TrainSession, _set_session
 
         fn = serialization.unpack(fn_blob)
         self.session = TrainSession(
             self.rank, self.world_size, dataset_shards=dataset_shards
         )
+        if initial_checkpoint is not None:
+            # restored trial (Tune resume / PBT exploit): visible via
+            # session.get_checkpoint()
+            self.session.latest_checkpoint = initial_checkpoint
         self._done = False
         self._error = None
 

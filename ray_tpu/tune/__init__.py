@@ -1,1 +1,22 @@
+"""Tune: distributed hyperparameter search (Ray Tune capability parity)."""
 
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+    "BasicVariantGenerator", "choice", "grid_search", "loguniform",
+    "randint", "uniform", "ResultGrid", "Trial", "TuneConfig", "Tuner",
+]

@@ -1,0 +1,127 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Parity: `/root/reference/python/ray/tune/schedulers/` —
+`async_hyperband.py` (ASHA: asynchronous successive halving with rungs at
+r·ηᵏ, cutting below-median trials at each rung) and `pbt.py`
+(population-based training: exploit top performers' config+checkpoint,
+explore by mutation).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+CONTINUE, STOP = "CONTINUE", "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial, result: dict) -> str:  # noqa: ARG002
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+    ):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.eta = reduction_factor
+        # rung milestones: grace, grace*eta, grace*eta^2, ... < max_t
+        self.rungs: list[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_records: dict[int, list[float]] = {r: [] for r in self.rungs}
+
+    def _better(self, a: float, cutoff: float) -> bool:
+        return a >= cutoff if self.mode == "max" else a <= cutoff
+
+    def on_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr)
+        score = result.get(self.metric)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in reversed(self.rungs):
+            if t == rung:
+                records = self.rung_records[rung]
+                records.append(float(score))
+                if len(records) < self.eta:
+                    return CONTINUE  # not enough evidence yet
+                k = max(1, len(records) // self.eta)
+                top = sorted(records, reverse=(self.mode == "max"))[:k]
+                cutoff = top[-1]
+                return CONTINUE if self._better(float(score), cutoff) else STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT-lite: at every perturbation interval, bottom-quantile trials adopt
+    a top-quantile trial's config (+checkpoint) with mutations."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: dict[str, Any] | None = None,
+        quantile_fraction: float = 0.25,
+        seed: int | None = None,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self.latest: dict[Any, dict] = {}     # trial → last result
+
+    def on_result(self, trial, result: dict) -> str:
+        self.latest[trial] = result
+        t = result.get(self.time_attr, 0)
+        if t and t % self.interval == 0:
+            self._maybe_exploit(trial, result)
+        return CONTINUE
+
+    def _maybe_exploit(self, trial, result: dict) -> None:
+        scored = [
+            (r.get(self.metric), tr) for tr, r in self.latest.items()
+            if r.get(self.metric) is not None
+        ]
+        if len(scored) < 2:
+            return
+        scored.sort(key=lambda x: x[0], reverse=(self.mode == "max"))
+        n = len(scored)
+        k = max(1, int(n * self.quantile))
+        top = [tr for _, tr in scored[:k]]
+        bottom = [tr for _, tr in scored[-k:]]
+        if trial in bottom and trial not in top:
+            src = self.rng.choice(top)
+            new_cfg = dict(src.config)
+            for key, spec in self.mutations.items():
+                if callable(spec):
+                    new_cfg[key] = spec()
+                elif isinstance(spec, list):
+                    new_cfg[key] = self.rng.choice(spec)
+                else:  # numeric factor perturbation
+                    factor = self.rng.choice([0.8, 1.2])
+                    new_cfg[key] = new_cfg.get(key, 1.0) * factor
+            trial.exploit_request = {
+                "config": new_cfg,
+                "from_trial": src,
+            }

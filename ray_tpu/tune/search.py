@@ -1,0 +1,110 @@
+"""Search spaces + suggestion generators.
+
+Parity: `/root/reference/python/ray/tune/search/` — sample-space primitives
+(`tune/search/sample.py`: uniform/loguniform/choice/randint/grid_search) and
+the BasicVariantGenerator (random + grid expansion,
+`search/basic_variant.py`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass
+from typing import Any
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class Randint(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Choice(Domain):
+    options: list
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+@dataclass
+class GridSearch:
+    values: list
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> Randint:
+    return Randint(low, high)
+
+
+def choice(options) -> Choice:
+    return Choice(list(options))
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(list(values))
+
+
+class BasicVariantGenerator:
+    """Grid axes fully expanded × num_samples random draws of the rest."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1,
+                 seed: int | None = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def variants(self) -> list[dict]:
+        grid_keys = [
+            k for k, v in self.param_space.items()
+            if isinstance(v, GridSearch)
+        ]
+        grids = [
+            [(k, val) for val in self.param_space[k].values] for k in grid_keys
+        ]
+        out = []
+        for combo in itertools.product(*grids) if grids else [()]:
+            for _ in range(self.num_samples):
+                cfg = {}
+                for k, v in self.param_space.items():
+                    if isinstance(v, GridSearch):
+                        continue
+                    cfg[k] = v.sample(self.rng) if isinstance(v, Domain) else v
+                cfg.update(dict(combo))
+                out.append(cfg)
+        return out

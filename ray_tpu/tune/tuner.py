@@ -1,0 +1,232 @@
+"""Tuner: hyperparameter search over trial actors.
+
+Parity: `/root/reference/python/ray/tune/tuner.py:44,239` (Tuner.fit),
+`tune/tune.py:131` (tune.run), `tune/execution/trial_runner.py:236`
+(TrialRunner event loop: launch ≤ max_concurrent trials as actors, poll
+results, apply scheduler decisions, retry failures). Trials run in
+TrainWorker actors (function-trainable with session.report), so the same
+session/report machinery serves Train and Tune.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.train.config import Result, RunConfig
+from ray_tpu.train.worker_group import TrainWorker
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import BasicVariantGenerator
+
+PENDING, RUNNING, TERMINATED, ERROR = (
+    "PENDING", "RUNNING", "TERMINATED", "ERROR",
+)
+
+
+@dataclass
+class TuneConfig:
+    metric: str | None = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int | None = None
+    time_attr: str = "training_iteration"
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.state = PENDING
+        self.actor = None
+        self.reports: list[dict] = []
+        self.last_checkpoint = None
+        self.error: str | None = None
+        self.iteration = 0
+        self.exploit_request: dict | None = None
+        self.failures = 0
+
+    def last_metrics(self) -> dict | None:
+        return self.reports[-1] if self.reports else None
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.state})"
+
+
+class ResultGrid:
+    def __init__(self, trials: list[Trial], metric: str | None, mode: str):
+        self.trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self.trials)
+
+    def __iter__(self):
+        for t in self.trials:
+            yield self._to_result(t)
+
+    def _to_result(self, t: Trial) -> Result:
+        return Result(
+            metrics={**(t.last_metrics() or {}), "config": t.config},
+            checkpoint=t.last_checkpoint,
+            error=RuntimeError(t.error) if t.error else None,
+            metrics_history=t.reports,
+        )
+
+    def get_best_result(self, metric: str | None = None,
+                        mode: str | None = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        assert metric, "metric required"
+        best, best_v = None, None
+        for t in self.trials:
+            m = t.last_metrics()
+            if not m or metric not in m:
+                continue
+            v = m[metric]
+            if (
+                best_v is None
+                or (mode == "max" and v > best_v)
+                or (mode == "min" and v < best_v)
+            ):
+                best, best_v = t, v
+        if best is None:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return self._to_result(best)
+
+    @property
+    def errors(self) -> list[str]:
+        return [t.error for t in self.trials if t.error]
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: dict | None = None,
+        tune_config: TuneConfig | None = None,
+        run_config: RunConfig | None = None,
+        resources_per_trial: dict[str, float] | None = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources = resources_per_trial or {"CPU": 1}
+
+    def fit(self, poll_interval: float = 0.15,
+            timeout: float | None = None) -> ResultGrid:
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        variants = BasicVariantGenerator(
+            self.param_space, tc.num_samples, tc.seed
+        ).variants()
+        trials = [
+            Trial(f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", cfg)
+            for i, cfg in enumerate(variants)
+        ]
+        fn_blob = serialization.pack(self.trainable)
+        pending = list(trials)
+        running: list[Trial] = []
+        max_failures = self.run_config.failure_config.max_failures
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        actor_cls = ray_tpu.remote(TrainWorker).options(
+            resources=self.resources, max_concurrency=4
+        )
+
+        def launch(trial: Trial, checkpoint=None):
+            trial.actor = actor_cls.remote(0, 1, None)
+            trial.actor.run_train_fn.remote(
+                fn_blob, trial.config, None, checkpoint
+            )
+            trial.state = RUNNING
+
+        while pending or running:
+            if deadline is not None and time.monotonic() > deadline:
+                for t in running:
+                    self._stop_actor(t)
+                    t.state = ERROR
+                    t.error = "tune timeout"
+                break
+            while pending and len(running) < tc.max_concurrent_trials:
+                t = pending.pop(0)
+                launch(t)
+                running.append(t)
+            time.sleep(poll_interval)
+            for t in list(running):
+                try:
+                    p = ray_tpu.get(t.actor.poll.remote(), timeout=60)
+                except ray_tpu.api.RayTaskError as e:
+                    t.failures += 1
+                    if t.failures <= max_failures:
+                        launch(t, t.last_checkpoint)
+                    else:
+                        t.state = ERROR
+                        t.error = str(e)
+                        running.remove(t)
+                    continue
+                decision = CONTINUE
+                for r in p["reports"]:
+                    t.iteration += 1
+                    r.setdefault(tc.time_attr, t.iteration)
+                    r["trial_id"] = t.trial_id
+                    t.reports.append(r)
+                    d = scheduler.on_result(t, r)
+                    if d == STOP:
+                        decision = STOP
+                if p.get("checkpoint") is not None:
+                    t.last_checkpoint = p["checkpoint"]
+                if t.exploit_request is not None:
+                    req = t.exploit_request
+                    t.exploit_request = None
+                    src: Trial = req["from_trial"]
+                    self._stop_actor(t)
+                    t.config = req["config"]
+                    ck = src.last_checkpoint or self._fetch_checkpoint(src)
+                    launch(t, ck)
+                    continue
+                if decision == STOP:
+                    self._stop_actor(t)
+                    t.state = TERMINATED
+                    running.remove(t)
+                elif p["error"]:
+                    t.failures += 1
+                    if t.failures <= max_failures:
+                        self._stop_actor(t)
+                        launch(t, t.last_checkpoint)
+                    else:
+                        t.state = ERROR
+                        t.error = p["error"]
+                        self._stop_actor(t)
+                        running.remove(t)
+                elif p["done"]:
+                    ck = self._fetch_checkpoint(t)
+                    if ck is not None:
+                        t.last_checkpoint = ck
+                    t.state = TERMINATED
+                    self._stop_actor(t)
+                    running.remove(t)
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+    def _fetch_checkpoint(self, t: Trial):
+        try:
+            return ray_tpu.get(t.actor.get_checkpoint.remote(), timeout=30)
+        except Exception:
+            return None
+
+    def _stop_actor(self, t: Trial) -> None:
+        if t.actor is not None:
+            try:
+                ray_tpu.kill(t.actor)
+            except Exception:
+                pass
+            t.actor = None

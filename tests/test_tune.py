@@ -1,0 +1,145 @@
+"""Tune tests: search spaces, trial runner, ASHA early stopping, PBT.
+
+Mirrors `/root/reference/python/ray/tune/tests/` behaviors at small scale.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, PopulationBasedTraining, TuneConfig, Tuner
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_variant_generation():
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.choice([1, 2]),
+        "fixed": 7,
+    }
+    variants = tune.BasicVariantGenerator(space, num_samples=3, seed=0).variants()
+    assert len(variants) == 6  # 2 grid × 3 samples
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(v["fixed"] == 7 for v in variants)
+    assert all(v["wd"] in (1, 2) for v in variants)
+
+
+def test_search_domains():
+    import random
+
+    rng = random.Random(0)
+    assert 1 <= tune.uniform(1, 2).sample(rng) <= 2
+    assert 1e-4 <= tune.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+    assert tune.randint(0, 5).sample(rng) in range(5)
+    assert tune.choice(["a", "b"]).sample(rng) in ("a", "b")
+
+
+def _objective(config):
+    from ray_tpu.train import session
+
+    # quadratic bowl: best at x=3
+    score = -((config["x"] - 3.0) ** 2)
+    for i in range(5):
+        session.report({"score": score + i * 0.01})
+
+
+def test_tuner_finds_best(cluster):
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=1,
+                               max_concurrent_trials=2),
+    )
+    grid = tuner.fit(timeout=300)
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.metrics["config"]["x"] == 3.0
+    assert best.metrics["score"] > -0.1
+
+
+def test_asha_early_stops(cluster):
+    def slow_objective(config):
+        import time
+
+        from ray_tpu.train import session
+
+        for i in range(1, 13):
+            session.report({"loss": config["badness"] * 1.0, "iter": i})
+            time.sleep(0.03)
+
+    scheduler = ASHAScheduler(
+        metric="loss", mode="min", time_attr="training_iteration",
+        max_t=12, grace_period=2, reduction_factor=2,
+    )
+    tuner = Tuner(
+        slow_objective,
+        param_space={"badness": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               scheduler=scheduler, max_concurrent_trials=4),
+    )
+    grid = tuner.fit(timeout=300)
+    best = grid.get_best_result()
+    assert best.metrics["config"]["badness"] == 1.0
+    # at least one bad trial stopped before finishing all 12 reports
+    n_reports = [len(t.reports) for t in grid.trials]
+    assert min(n_reports) < 12, n_reports
+
+
+def test_trial_error_handling(cluster):
+    def sometimes_fails(config):
+        from ray_tpu.train import session
+
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        session.report({"score": config["x"]})
+
+    tuner = Tuner(
+        sometimes_fails,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    )
+    grid = tuner.fit(timeout=300)
+    assert len(grid.errors) == 1
+    assert grid.get_best_result().metrics["config"]["x"] == 2
+
+
+def test_pbt_exploits(cluster):
+    def trainable(config):
+        import time
+
+        from ray_tpu.train import session
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        # score grows at rate `rate`; PBT should propagate high-rate configs
+        ck = session.get_checkpoint()
+        score = ck["score"] if ck else 0.0
+        for i in range(1, 11):
+            score += config["rate"]
+            session.report(
+                {"score": score},
+                checkpoint=Checkpoint.from_dict({"score": score}),
+            )
+            time.sleep(0.05)
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"rate": [1.0, 5.0]}, seed=0,
+        quantile_fraction=0.34,
+    )
+    tuner = Tuner(
+        trainable,
+        param_space={"rate": tune.grid_search([0.1, 0.1, 5.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt,
+                               max_concurrent_trials=3),
+    )
+    grid = tuner.fit(timeout=300)
+    best = grid.get_best_result()
+    # the winning lineage must have adopted the high rate
+    assert best.metrics["score"] > 10
