@@ -1,1 +1,21 @@
+"""Serve: model serving with replica autoscaling (Ray Serve parity)."""
 
+from ray_tpu.serve.api import (
+    Deployment,
+    DeploymentHandle,
+    batch,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.http_proxy import start_proxy
+
+__all__ = [
+    "Deployment", "DeploymentHandle", "batch", "delete", "deployment",
+    "get_deployment_handle", "run", "shutdown", "start", "status",
+    "start_proxy",
+]

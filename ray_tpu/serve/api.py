@@ -1,0 +1,326 @@
+"""Serve public API: @deployment, run, handles, batching.
+
+Parity: `/root/reference/python/ray/serve/api.py:277,455` (@serve.deployment,
+serve.run), `_private/router.py:62` (power-of-two-choices replica selection),
+`serve/batching.py` (@serve.batch). The HTTP ingress lives in http_proxy.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.core import serialization
+
+CONTROLLER_NAME = "ray_tpu_serve_controller"
+_local = threading.local()
+
+
+def _get_controller(create: bool = False):
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise RuntimeError("serve not started — call serve.start() or serve.run()")
+        from ray_tpu.serve.controller import ServeController
+
+        ctrl = ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=16,
+        ).remote()
+        return ctrl
+
+
+def start():
+    return _get_controller(create=True)
+
+
+def shutdown():
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(ctrl.shutdown.remote(), timeout=60)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(ctrl)
+    except Exception:
+        pass
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    route_prefix: str | None = None
+    ray_actor_options: dict | None = None
+    max_concurrent_queries: int = 8
+    user_config: Any = None
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+
+    def options(self, **kw) -> "Deployment":
+        import dataclasses
+
+        return dataclasses.replace(self, **kw)
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        """DAG-style binding of constructor args (ref: serve DAG API)."""
+        import dataclasses
+
+        return dataclasses.replace(
+            self, init_args=args, init_kwargs=kwargs
+        )
+
+
+def deployment(_func_or_class=None, *, name: str | None = None,
+               num_replicas: int = 1, route_prefix: str | None = None,
+               ray_actor_options: dict | None = None,
+               max_concurrent_queries: int = 8,
+               user_config: Any = None):
+    def make(target):
+        return Deployment(
+            func_or_class=target,
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            route_prefix=(
+                route_prefix if route_prefix is not None
+                else f"/{name or getattr(target, '__name__', 'deployment')}"
+            ),
+            ray_actor_options=ray_actor_options,
+            max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
+        )
+
+    if _func_or_class is not None:
+        return make(_func_or_class)
+    return make
+
+
+class DeploymentHandle:
+    """Client-side handle: routes calls to replicas with power-of-two-choices
+    (ref: router.py ReplicaSet)."""
+
+    REFRESH_TTL_S = 1.0
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._version = -1
+        self._replicas: list = []
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    def _refresh(self, force: bool = False):
+        ctrl = _get_controller()
+        table = ray_tpu.get(
+            ctrl.get_routing.remote(-1 if force else self._version),
+            timeout=30,
+        )
+        with self._lock:
+            self._last_refresh = time.monotonic()
+            if table is None:
+                return
+            self._version = table["version"]
+            route = table["routes"].get(self.deployment_name)
+            self._replicas = route["replicas"] if route else []
+
+    def _alive(self, replicas: list) -> list:
+        """Drop replicas this client already knows are dead (pubsub)."""
+        from ray_tpu import api as _api
+
+        client = _api._ensure_client()
+        return [
+            r for r in replicas
+            if not client.actor_state(r._actor_id.binary()).dead
+        ]
+
+    def _pick_replica(self):
+        import random
+
+        replicas: list = []
+        for attempt in range(4):
+            with self._lock:
+                stale = (
+                    time.monotonic() - self._last_refresh > self.REFRESH_TTL_S
+                )
+                replicas = self._alive(self._replicas)
+            if replicas and not stale:
+                break
+            self._refresh(force=not replicas)
+            with self._lock:
+                replicas = self._alive(self._replicas)
+            if replicas:
+                break
+            time.sleep(0.3 * (attempt + 1))
+        if not replicas:
+            raise RuntimeError(
+                f"no replicas for deployment {self.deployment_name!r}"
+            )
+        if len(replicas) == 1:
+            return replicas[0]
+        # power-of-two-choices on in-flight counts
+        a, b = random.sample(replicas, 2)
+        try:
+            la, lb = ray_tpu.get(
+                [a.num_inflight.remote(), b.num_inflight.remote()], timeout=10
+            )
+        except Exception:
+            self._refresh(force=True)
+            return random.choice(replicas)
+        return a if la <= lb else b
+
+    def remote(self, *args, **kwargs):
+        return self.method("__call__", *args, **kwargs)
+
+    def method(self, method_name: str, *args, **kwargs):
+        replica = self._pick_replica()
+        return replica.handle_request.remote(method_name, args, kwargs)
+
+
+def run(target: Deployment, *, name: str | None = None,
+        route_prefix: str | None = None, _blocking_until_ready: bool = True,
+        timeout: float = 120.0) -> DeploymentHandle:
+    ctrl = _get_controller(create=True)
+    dep = target
+    if route_prefix is not None:
+        dep = dep.options(route_prefix=route_prefix)
+    cls_blob = serialization.pack(dep.func_or_class)
+    resources = None
+    if dep.ray_actor_options:
+        resources = dict(dep.ray_actor_options.get("resources", {}) or {})
+        if "num_cpus" in dep.ray_actor_options:
+            resources["CPU"] = dep.ray_actor_options["num_cpus"]
+        if "num_tpus" in dep.ray_actor_options:
+            resources["TPU"] = dep.ray_actor_options["num_tpus"]
+    ray_tpu.get(ctrl.deploy.remote(
+        dep.name, cls_blob, dep.init_args, dep.init_kwargs,
+        dep.num_replicas, dep.route_prefix, resources,
+        dep.max_concurrent_queries, dep.user_config,
+    ), timeout=timeout)
+    handle = DeploymentHandle(dep.name)
+    if _blocking_until_ready:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            deps = ray_tpu.get(ctrl.list_deployments.remote(), timeout=30)
+            info = deps.get(dep.name)
+            if info and info["live_replicas"] >= info["num_replicas"]:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(f"deployment {dep.name} not ready")
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str) -> None:
+    ctrl = _get_controller()
+    ray_tpu.get(ctrl.delete_deployment.remote(name), timeout=60)
+
+
+def status() -> dict:
+    ctrl = _get_controller()
+    return ray_tpu.get(ctrl.list_deployments.remote(), timeout=30)
+
+
+# ---------------------------------------------------------------- batching
+
+def batch(_func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """@serve.batch: concurrent calls buffer into one list-in/list-out call
+    (ref: serve/batching.py). The wrapped fn receives a list of inputs and
+    must return a list of outputs of equal length."""
+
+    def deco(fn):
+        # Per-process state, created lazily inside the replica — threading
+        # primitives must not be captured at decoration time (the deployment
+        # class is cloudpickled to replicas).
+        def _state():
+            st = wrapper.__dict__.get("_batch_state")
+            if st is None:
+                # dict.setdefault is atomic under the GIL — exactly one
+                # candidate state wins even under concurrent first calls
+                st = wrapper.__dict__.setdefault(
+                    "_batch_state",
+                    {"buf": [], "lock": threading.Lock(), "timer": None},
+                )
+            return st
+
+        class _Slot:
+            __slots__ = ("event", "result", "error")
+
+            def __init__(self):
+                self.event = threading.Event()
+                self.result = None
+                self.error = None
+
+        def flush():
+            state = _state()
+            with state["lock"]:
+                buf, state["buf"] = state["buf"], []
+                state["timer"] = None
+            if not buf:
+                return
+            self_obj = buf[0][0]
+            inputs = [a for _, a, _ in buf]
+            try:
+                outputs = (
+                    fn(self_obj, inputs) if self_obj is not None else fn(inputs)
+                )
+                if len(outputs) != len(inputs):
+                    raise ValueError(
+                        f"batched fn returned {len(outputs)} outputs for "
+                        f"{len(inputs)} inputs"
+                    )
+                for (_, _, slot), out in zip(buf, outputs):
+                    slot.result = out
+                    slot.event.set()
+            except Exception as e:
+                for _, _, slot in buf:
+                    slot.error = e
+                    slot.event.set()
+
+        def wrapper(*call_args):
+            # supports both plain functions fn(items) and methods
+            # fn(self, items): the per-call payload is the last positional arg
+            if len(call_args) == 2:
+                self_obj, arg = call_args
+            elif len(call_args) == 1:
+                self_obj, arg = None, call_args[0]
+            else:
+                raise TypeError("@serve.batch functions take exactly one arg")
+            slot = _Slot()
+            do_flush = False
+            state = _state()
+            with state["lock"]:
+                state["buf"].append((self_obj, arg, slot))
+                if len(state["buf"]) >= max_batch_size:
+                    do_flush = True
+                elif state["timer"] is None:
+                    state["timer"] = threading.Timer(
+                        batch_wait_timeout_s, flush
+                    )
+                    state["timer"].daemon = True
+                    state["timer"].start()
+            if do_flush:
+                flush()
+            slot.event.wait()
+            if slot.error is not None:
+                raise slot.error
+            return slot.result
+
+        wrapper.__name__ = getattr(fn, "__name__", "batched")
+        wrapper._batched = True
+        return wrapper
+
+    if _func is not None:
+        return deco(_func)
+    return deco

@@ -1,0 +1,157 @@
+"""ServeController: singleton control-plane actor.
+
+Parity: `/root/reference/python/ray/serve/controller.py:61` +
+`_private/deployment_state.py:1767` — reconciles desired deployment state
+(replica count, config, user code version) against actual replica actors,
+restarts dead replicas, and serves routing tables to handles/proxies (the
+reference fans these out via LongPollHost; here handles poll with a version
+counter, same effect).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class ServeController:
+    """Runs as a named detached actor ("ray_tpu_serve_controller")."""
+
+    def __init__(self):
+        # name → deployment record
+        self.deployments: dict[str, dict] = {}
+        self.version = 0
+        self._lock = threading.Lock()
+        self._stop = False
+        self._reconciler = threading.Thread(target=self._loop, daemon=True)
+        self._reconciler.start()
+
+    # ------------------------------------------------------------ API
+
+    def deploy(self, name: str, cls_blob: bytes, init_args: tuple,
+               init_kwargs: dict, num_replicas: int,
+               route_prefix: str | None,
+               resources: dict | None,
+               max_concurrent_queries: int = 8,
+               user_config: Any = None) -> bool:
+        with self._lock:
+            old = self.deployments.get(name)
+            self.deployments[name] = {
+                "name": name,
+                "cls_blob": cls_blob,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "num_replicas": num_replicas,
+                "route_prefix": route_prefix,
+                "resources": resources,
+                "max_concurrent_queries": max_concurrent_queries,
+                "user_config": user_config,
+                "replicas": old["replicas"] if old else [],
+                "generation": (old["generation"] + 1) if old else 0,
+            }
+            if old:
+                # config/code changed → roll all replicas
+                self._drain_replicas(self.deployments[name], all=True)
+            self.version += 1
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            d = self.deployments.pop(name, None)
+            if d:
+                self._drain_replicas(d, all=True)
+            self.version += 1
+        return True
+
+    def get_routing(self, known_version: int = -1) -> dict | None:
+        """Routing table for handles/proxies; None if caller is up to date."""
+        if known_version == self.version:
+            return None
+        routes = {}
+        with self._lock:
+            for name, d in self.deployments.items():
+                routes[name] = {
+                    "replicas": [h for (_aid, h) in d["replicas"]],
+                    "route_prefix": d["route_prefix"],
+                    "max_concurrent_queries": d["max_concurrent_queries"],
+                }
+        return {"version": self.version, "routes": routes}
+
+    def list_deployments(self) -> dict:
+        with self._lock:
+            return {
+                name: {
+                    "num_replicas": d["num_replicas"],
+                    "live_replicas": len(d["replicas"]),
+                    "route_prefix": d["route_prefix"],
+                }
+                for name, d in self.deployments.items()
+            }
+
+    def shutdown(self) -> bool:
+        self._stop = True
+        with self._lock:
+            for d in self.deployments.values():
+                self._drain_replicas(d, all=True)
+            self.deployments.clear()
+            self.version += 1
+        return True
+
+    # ------------------------------------------------------------ reconcile
+
+    def _drain_replicas(self, d: dict, all: bool = False, keep: int = 0):
+        import ray_tpu
+
+        victims = d["replicas"] if all else d["replicas"][keep:]
+        for _aid, handle in victims:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+        d["replicas"] = [] if all else d["replicas"][:keep]
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                self._reconcile_once()
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+    def _reconcile_once(self):
+        """Desired → actual: start missing replicas, reap dead ones
+        (ref: deployment_state.py:958 reconcile loop)."""
+        import ray_tpu
+        from ray_tpu.core import serialization
+        from ray_tpu.serve.replica import Replica
+
+        with self._lock:
+            for d in self.deployments.values():
+                # health-check existing replicas
+                alive = []
+                changed = False
+                for aid, handle in d["replicas"]:
+                    try:
+                        ray_tpu.get(handle.health.remote(), timeout=10)
+                        alive.append((aid, handle))
+                    except Exception:
+                        changed = True
+                d["replicas"] = alive
+                while len(d["replicas"]) > d["num_replicas"]:
+                    self._drain_replicas(d, keep=d["num_replicas"])
+                    changed = True
+                while len(d["replicas"]) < d["num_replicas"]:
+                    opts = {"max_concurrency": max(2, d["max_concurrent_queries"])}
+                    if d["resources"]:
+                        opts["resources"] = d["resources"]
+                    replica_cls = ray_tpu.remote(Replica).options(**opts)
+                    h = replica_cls.remote(
+                        d["cls_blob"], d["init_args"], d["init_kwargs"],
+                        d["user_config"],
+                    )
+                    d["replicas"].append((h._actor_id.hex(), h))
+                    changed = True
+                if changed:
+                    self.version += 1

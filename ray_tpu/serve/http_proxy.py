@@ -1,0 +1,136 @@
+"""HTTP ingress proxy.
+
+Parity: `/root/reference/python/ray/serve/_private/http_proxy.py:217,386`
+(HTTPProxyActor + LongestPrefixRouter). A threaded stdlib HTTP server runs
+inside a proxy actor; requests route by longest matching route_prefix to a
+DeploymentHandle. Bodies: JSON in → JSON out.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class HTTPProxy:
+    """Actor: one per node in the reference; one total here (v1)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.serve.api import DeploymentHandle, _get_controller
+
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._routes: dict[str, str] = {}   # prefix → deployment name
+        self._lock = threading.Lock()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self):
+                parsed = urlparse(self.path)
+                name = proxy._match(parsed.path)
+                if name is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no route"}')
+                    return
+                if self.command == "POST":
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length) if length else b"{}"
+                    try:
+                        payload = json.loads(raw) if raw.strip() else {}
+                    except json.JSONDecodeError:
+                        payload = {"body": raw.decode("utf-8", "replace")}
+                else:
+                    q = parse_qs(parsed.query)
+                    payload = {k: v[0] if len(v) == 1 else v
+                               for k, v in q.items()}
+                try:
+                    handle = proxy._handle(name)
+                    import ray_tpu
+
+                    result = ray_tpu.get(handle.remote(payload), timeout=120)
+                    body = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(
+                        json.dumps({"error": str(e)}).encode()
+                    )
+
+            do_GET = _dispatch
+            do_POST = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self._refresher = threading.Thread(target=self._refresh_loop,
+                                           daemon=True)
+        self._refresher.start()
+
+    def _match(self, path: str) -> str | None:
+        with self._lock:
+            best = None
+            for prefix, name in self._routes.items():
+                if prefix and path.startswith(prefix):
+                    if best is None or len(prefix) > len(best[0]):
+                        best = (prefix, name)
+            return best[1] if best else None
+
+    def _handle(self, name: str):
+        from ray_tpu.serve.api import DeploymentHandle
+
+        with self._lock:
+            h = self._handles.get(name)
+            if h is None:
+                h = DeploymentHandle(name)
+                self._handles[name] = h
+            return h
+
+    def _refresh_loop(self):
+        import time
+
+        import ray_tpu
+        from ray_tpu.serve.api import _get_controller
+
+        while True:
+            try:
+                ctrl = _get_controller()
+                table = ray_tpu.get(ctrl.get_routing.remote(-1), timeout=30)
+                if table:
+                    with self._lock:
+                        self._routes = {
+                            r["route_prefix"]: name
+                            for name, r in table["routes"].items()
+                            if r["route_prefix"]
+                        }
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+    def get_port(self) -> int:
+        return self.port
+
+    def health(self) -> bool:
+        return True
+
+
+def start_proxy(port: int = 0):
+    """Start (or fetch) the singleton proxy actor; returns (handle, port)."""
+    import ray_tpu
+
+    proxy = ray_tpu.remote(HTTPProxy).options(
+        name="ray_tpu_serve_proxy", get_if_exists=True, max_concurrency=32,
+    ).remote(port=port)
+    actual = ray_tpu.get(proxy.get_port.remote(), timeout=60)
+    return proxy, actual

@@ -1,0 +1,55 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Parity: `/root/reference/python/ray/serve/_private/replica.py` — wraps the
+user class/function, counts in-flight queries (for power-of-two routing),
+applies reconfigure(user_config), and reports health.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ray_tpu.core import serialization
+
+
+class Replica:
+    def __init__(self, cls_blob: bytes, init_args: tuple, init_kwargs: dict,
+                 user_config: Any = None):
+        target = serialization.unpack(cls_blob)
+        if isinstance(target, type):
+            self.callable = target(*init_args, **(init_kwargs or {}))
+        else:
+            self.callable = target
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._processed = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def health(self) -> bool:
+        return True
+
+    def reconfigure(self, user_config: Any) -> bool:
+        fn = getattr(self.callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def num_inflight(self) -> int:
+        return self._inflight
+
+    def stats(self) -> dict:
+        return {"inflight": self._inflight, "processed": self._processed}
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._inflight += 1
+        try:
+            if method == "__call__":
+                return self.callable(*args, **kwargs)
+            return getattr(self.callable, method)(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._processed += 1
