@@ -236,6 +236,9 @@ class Raylet:
                 logger.warning("worker %s disconnected", WorkerID(wid).hex()[:8])
                 self._return_resources(h)
                 self.workers.pop(wid, None)
+                # Freed resources may satisfy queued lease requests; without a
+                # pump they would sit until lease_timeout_s.
+                self._pump_leases()
 
     def _return_resources(self, h: WorkerHandle) -> None:
         for k, v in h.lease_resources.items():
