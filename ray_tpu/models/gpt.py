@@ -40,7 +40,7 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     tie_embeddings: bool = True
     remat: bool = False              # jax.checkpoint each block (for big models)
-    attn_impl: str = "xla"           # "xla" | "flash" (pallas, TPU only)
+    attn_impl: str = "xla"           # "xla" | "flash" (pallas) | "ring" (sp-sharded)
 
     @property
     def head_dim(self) -> int:
@@ -151,12 +151,24 @@ def _rotary(x: jax.Array, rotary_dim: int, offset: int = 0) -> jax.Array:
     return jnp.concatenate([rot, rest], axis=-1)
 
 
-def _attention(q, k, v, cfg: GPTConfig, *, causal_offset: int = 0):
+def _attention(q, k, v, cfg: GPTConfig, *, causal_offset: int = 0, mesh=None):
     """q,k,v: [B, S, H, K] (q) / [B, T, H, K] (k,v). fp32 logits+softmax."""
+    if cfg.attn_impl in ("flash", "ring") and causal_offset != 0:
+        raise NotImplementedError(
+            f"causal_offset is only supported by attn_impl='xla', "
+            f"not {cfg.attn_impl!r} (decode paths use the serve KV cache)"
+        )
     if cfg.attn_impl == "flash":
         from ray_tpu.ops.attention import flash_attention
 
         return flash_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "ring":
+        from ray_tpu.parallel.ring import ring_attention_sharded
+
+        if mesh is None:
+            raise ValueError("attn_impl='ring' requires forward(..., mesh=)")
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        return ring_attention_sharded(q, k, v, mesh, causal=True, impl=impl)
     S, T = q.shape[-3], k.shape[-3]
     scale = 1.0 / math.sqrt(cfg.head_dim)
     logits = jnp.einsum(
@@ -170,7 +182,9 @@ def _attention(q, k, v, cfg: GPTConfig, *, causal_offset: int = 0):
     return jnp.einsum("bhst,bthk->bshk", probs, v)
 
 
-def _block(x: jax.Array, layer: dict[str, jax.Array], cfg: GPTConfig) -> jax.Array:
+def _block(
+    x: jax.Array, layer: dict[str, jax.Array], cfg: GPTConfig, mesh=None
+) -> jax.Array:
     """One pre-norm transformer block. x: [B, S, D]."""
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
@@ -178,7 +192,7 @@ def _block(x: jax.Array, layer: dict[str, jax.Array], cfg: GPTConfig) -> jax.Arr
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
     q = _rotary(q, cfg.rotary_dim)
     k = _rotary(k, cfg.rotary_dim)
-    attn = _attention(q, k, v, cfg)
+    attn = _attention(q, k, v, cfg, mesh=mesh)
     attn_out = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(cfg.dtype))
     x = x + attn_out
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
@@ -196,16 +210,24 @@ _BLOCK_KEYS = (
 )
 
 
-def forward(params: dict[str, jax.Array], tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
-    """tokens: [B, S] int32 → logits [B, S, V] (cfg.dtype)."""
+def forward(
+    params: dict[str, jax.Array],
+    tokens: jax.Array,
+    cfg: GPTConfig,
+    mesh=None,
+) -> jax.Array:
+    """tokens: [B, S] int32 → logits [B, S, V] (cfg.dtype).
+
+    `mesh` is only consulted when cfg.attn_impl == "ring" (the sp-sharded
+    ring-attention path runs in an explicit shard_map over it).
+    """
     x = params["wte"].astype(cfg.dtype)[tokens]
     stacked = {k: params[k] for k in _BLOCK_KEYS}
+    block_fn = lambda x, layer: _block(x, layer, cfg, mesh)
 
     def body(x, layer):
-        fn = _block
-        if cfg.remat:
-            fn = jax.checkpoint(_block, static_argnums=(2,))
-        return fn(x, layer, cfg), None
+        fn = jax.checkpoint(block_fn) if cfg.remat else block_fn
+        return fn(x, layer), None
 
     x, _ = jax.lax.scan(body, x, stacked)
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
@@ -222,9 +244,10 @@ def loss_fn(
     tokens: jax.Array,
     targets: jax.Array,
     cfg: GPTConfig,
+    mesh=None,
 ) -> jax.Array:
     """Mean next-token cross-entropy. tokens/targets: [B, S] int32."""
-    logits = forward(params, tokens, cfg)  # fp32
+    logits = forward(params, tokens, cfg, mesh)  # fp32
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(logz - gold)

@@ -1,1 +1,5 @@
+"""TPU kernels (Pallas) and their XLA reference implementations."""
 
+from ray_tpu.ops.attention import flash_attention, reference_attention
+
+__all__ = ["flash_attention", "reference_attention"]

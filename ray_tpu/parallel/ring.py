@@ -1,0 +1,152 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Net-new capability relative to the reference (SURVEY.md §5.7: the reference
+has no sequence/context parallelism anywhere — grep-verified), built the TPU
+way: the sequence is sharded into contiguous chunks over the ``sp`` axis;
+each device computes blockwise attention against the KV chunk it currently
+holds while ``jax.lax.ppermute`` rotates KV around the ring over ICI, and the
+per-chunk partial results are merged with the standard (o, lse) log-sum-exp
+combine. Compute overlaps communication because XLA pipelines the ppermute
+with the next chunk's attention inside the scan.
+
+Differentiable end-to-end: the flash kernel (ops/attention.py) exposes lse
+with a custom VJP that accepts an lse cotangent, ppermute's VJP is the
+reversed permutation, and the combine is plain jnp.
+
+Causal chunking: with contiguous chunks, chunk j of KV is fully visible to
+queries in chunk i when j < i, diagonally (causally) visible when j == i, and
+invisible when j > i — invisible steps are skipped via ``lax.switch`` into a
+zero/-inf branch. (A zigzag chunk order would balance causal load across the
+ring; contiguous is used for simplicity and correctness first.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import (
+    NEG_INF,
+    flash_attention,
+    reference_attention,
+)
+
+
+def _combine(o1, lse1, o2, lse2):
+    """Merge two partial attention results. o: [B,S,H,K], lse: [B,S,H]."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    w1 = jnp.exp(lse1 - m_safe)
+    w2 = jnp.exp(lse2 - m_safe)
+    denom = w1 + w2
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    o = (
+        o1 * (w1 / denom_safe)[..., None].astype(o1.dtype)
+        + o2 * (w2 / denom_safe)[..., None].astype(o2.dtype)
+    )
+    lse = jnp.where(denom == 0.0, NEG_INF, m_safe + jnp.log(denom_safe))
+    return o, lse
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    impl: Literal["flash", "xla"] = "flash",
+) -> jax.Array:
+    """Ring attention over an SPMD axis. Call inside shard_map/pjit manual.
+
+    q, k, v: the *local* sequence chunk, [B, S_local, H, K]; the global
+    sequence is the concatenation of chunks in axis-index order.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    if impl == "flash":
+        attn = functools.partial(flash_attention, sm_scale=sm_scale, return_lse=True)
+    else:
+        attn = functools.partial(reference_attention, sm_scale=sm_scale, return_lse=True)
+
+    def full_branch(kv):
+        kc, vc = kv
+        return attn(q, kc, vc, causal=False)
+
+    def diag_branch(kv):
+        kc, vc = kv
+        return attn(q, kc, vc, causal=True)
+
+    def _zero_state():
+        # Derive from q so the outputs carry q's varying-manual-axes type
+        # (a plain constant would fail shard_map's VMA check in lax.switch).
+        o = q * 0
+        lse = 0.0 * q[..., 0].astype(jnp.float32) + NEG_INF
+        return o, lse
+
+    def masked_branch(kv):
+        return _zero_state()
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        o, lse, k_cur, v_cur = carry
+        # Rotate first: n-1 rotations total (the held chunk is consumed
+        # before the scan; a rotate-last body would pay one wasted ppermute
+        # pair per layer since XLA can't drop collectives from a scan body).
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (my - step) % n  # chunk index this device now holds
+        if causal:
+            case = jnp.where(src < my, 0, 2)  # step >= 1 → never the diagonal
+            o2, lse2 = jax.lax.switch(
+                case, (full_branch, diag_branch, masked_branch), (k_cur, v_cur)
+            )
+        else:
+            o2, lse2 = full_branch((k_cur, v_cur))
+        o, lse = _combine(o, lse, o2, lse2)
+        return (o, lse, k_cur, v_cur), None
+
+    # Step 0: attend to the locally-held chunk (the causal diagonal).
+    o0, lse0 = diag_branch((k, v)) if causal else full_branch((k, v))
+    if n == 1:
+        return o0
+    (o, lse, _, _), _ = jax.lax.scan(body, (o0, lse0, k, v), jnp.arange(1, n))
+    return o
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    impl: Literal["flash", "xla"] = "flash",
+    axis_name: str = "sp",
+) -> jax.Array:
+    """shard_map wrapper: global [B,S,H,K] arrays, seq sharded over ``sp``,
+    batch over (dp,fsdp), heads over tp. Usable directly inside a pjit
+    program (nested shard_map)."""
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    fn = functools.partial(
+        ring_attention, axis_name=axis_name, causal=causal,
+        sm_scale=sm_scale, impl=impl,
+    )
+    return jax.shard_map(
+        lambda a, b, c: fn(a, b, c),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # pallas_call out_shapes carry no varying-manual-axes annotation, so
+        # the strict VMA checker rejects them; replication safety here is by
+        # construction (every output is derived from per-device inputs).
+        check_vma=False,
+    )(q, k, v)

@@ -111,15 +111,15 @@ def build_training(
     )
     o_shard = opt_state_shardings(optimizer, params, p_shard)
     opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
-    loss = partial_loss(cfg)
+    loss = partial_loss(cfg, mesh)
     step_fn = make_train_step(loss, optimizer, mesh, p_shard, o_shard)
     return params, opt_state, step_fn
 
 
-def partial_loss(cfg):
+def partial_loss(cfg, mesh=None):
     from ray_tpu.models import gpt
 
     def loss(params, tokens, targets):
-        return gpt.loss_fn(params, tokens, targets, cfg)
+        return gpt.loss_fn(params, tokens, targets, cfg, mesh)
 
     return loss

@@ -1,0 +1,79 @@
+"""Ring attention (sequence/context parallelism) on the 8-device CPU mesh.
+
+Net-new vs the reference (SURVEY.md §5.7) — validated against full
+(unsharded) attention, including gradients and an end-to-end sp-sharded
+GPT train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import gpt
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+from ray_tpu.parallel.ring import ring_attention_sharded
+from ray_tpu.train import spmd
+
+
+def _qkv(B=4, S=256, H=2, K=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, S, H, K)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.fixture(scope="module")
+def sp_mesh(cpu_devices):
+    return make_mesh(MeshConfig(dp=2, fsdp=1, sp=4, tp=1))
+
+
+@pytest.mark.parametrize("impl", ["xla", "flash"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_full(sp_mesh, impl, causal):
+    q, k, v = _qkv()
+    o = jax.jit(
+        lambda q, k, v: ring_attention_sharded(
+            q, k, v, sp_mesh, causal=causal, impl=impl
+        )
+    )(q, k, v)
+    o_ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=3e-5)
+
+
+def test_ring_grads(sp_mesh):
+    q, k, v = _qkv()
+
+    def f(q, k, v):
+        o = ring_attention_sharded(q, k, v, sp_mesh, causal=True, impl="flash")
+        return jnp.sum(o * jnp.cos(o))
+
+    def f_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_sp_training_step_with_ring(sp_mesh):
+    """GPT train step with attn_impl='ring' on a dp×sp mesh: loss finite and
+    close to the xla-attention loss on identical params/batch."""
+    cfg_ring = gpt.GPTConfig.tiny(attn_impl="ring")
+    cfg_ref = gpt.GPTConfig.tiny(attn_impl="xla")
+    opt = optax.adamw(1e-3)
+    params, opt_state, step = spmd.build_training(
+        cfg_ring, sp_mesh, opt, jax.random.key(0)
+    )
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg_ring.vocab_size, (8, 128)), jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    loss_ring = gpt.loss_fn(params, toks, tgts, cfg_ring, sp_mesh)
+    loss_ref = gpt.loss_fn(params, toks, tgts, cfg_ref)
+    np.testing.assert_allclose(float(loss_ring), float(loss_ref), rtol=1e-4)
+
+    params, opt_state, loss = step(params, opt_state, (toks, tgts))
+    assert np.isfinite(float(loss))
