@@ -23,7 +23,7 @@ from typing import Any, Sequence
 from ray_tpu.core import rpc, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
-from ray_tpu.core.object_store import attach_segment
+from ray_tpu.core.object_store import attach_extent
 from ray_tpu.core.task_spec import (
     ACTOR_CREATION,
     ACTOR_TASK,
@@ -173,7 +173,7 @@ class CoreClient:
             resp = self._run(self.raylet.call("store_create", {
                 "object_id": obj.binary(), "size": size,
             }))
-            view = attach_segment(resp["shm_name"], size)
+            view = attach_extent(resp["arena"], resp["offset"], size)
             serialization.write_to(view, head, views)
             view.release()
             self._run(self.raylet.call("store_seal", {"object_id": obj.binary()}))
@@ -215,8 +215,8 @@ class CoreClient:
                 if loc == "inline":
                     value = serialization.unpack(data)
                 else:
-                    name, size = data
-                    view = attach_segment(name, size)
+                    name, offset, size = data
+                    view = attach_extent(name, offset, size)
                     self._mmaps[key] = view
                     value = serialization.unpack(view)
                 self._memory_store[key] = value

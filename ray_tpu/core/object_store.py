@@ -3,18 +3,20 @@
 Parity target: the reference's plasma store (`/root/reference/src/ray/
 object_manager/plasma/store.h:55`) — an mmap'd arena shared across all
 processes on a node with zero-copy reads, eviction, spilling, and
-backpressured creation. TPU-first simplifications:
+backpressured creation. Architecture:
 
-- Segments are files under /dev/shm mmap'd by name (same kernel mechanism as
-  plasma's fd-passing without the unix-socket dance; attach-by-name replaces
-  fling.cc). One segment per object; a slab arena + C++ allocator is a later
-  optimization.
-- The store's *metadata* (what exists, where, sealed state, pins) lives in the
-  node daemon process; clients create/write/seal segments directly and only
+- ONE mmap'd slab per node under /dev/shm, managed by the native C++
+  best-fit/coalescing allocator (`ray_tpu/_native/arena.cc` — the equivalent
+  of plasma's `plasma_allocator.cc` + `dlmalloc.cc`). Objects are (offset,
+  size) extents. Clients attach the slab once by name and slice at offsets —
+  attach-by-name replaces plasma's unix-socket fd passing (`fling.cc`).
+- The store's *metadata* (what exists, sealed state, pins) lives in the node
+  daemon process; clients create/write/seal extents directly and only
   metadata crosses the RPC boundary — data never does (except inline small
   objects, ref: ray_config_def.h:210 max_direct_call_object_size=100KB).
 - Spill-to-disk under memory pressure + restore on demand
-  (ref: local_object_manager.h:41, external_storage.py).
+  (ref: local_object_manager.h:41, external_storage.py). Restore may place
+  the object at a new offset; objects pinned by readers are never spilled.
 """
 
 from __future__ import annotations
@@ -27,6 +29,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+from ray_tpu._native import ArenaAllocator
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core import serialization
@@ -40,37 +43,50 @@ def shm_path(name: str) -> str:
     return os.path.join(SHM_DIR, name)
 
 
-def create_segment(name: str, size: int) -> memoryview:
-    """Create + mmap a shared segment; returns writable view."""
-    path = shm_path(name)
-    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+_arena_cache: dict[str, memoryview] = {}
+
+
+def sweep_stale_arenas() -> int:
+    """Unlink slabs whose owner daemon died without shutdown (arena names end
+    in the owner's pid). Called on store startup; plasma gets this for free by
+    owning fds, we attach by name instead."""
+    n = 0
     try:
-        os.ftruncate(fd, size)
-        mm = mmap.mmap(fd, size)
-    finally:
-        os.close(fd)
-    return memoryview(mm)
+        names = os.listdir(SHM_DIR)
+    except OSError:
+        return 0
+    for fn in names:
+        if not fn.startswith("raytpu-arena-"):
+            continue
+        pid = fn.rsplit("-", 1)[-1]
+        if pid.isdigit() and not os.path.exists(f"/proc/{pid}"):
+            try:
+                os.unlink(os.path.join(SHM_DIR, fn))
+                n += 1
+            except OSError:
+                pass
+    return n
 
 
-def attach_segment(name: str, size: int) -> memoryview:
-    path = shm_path(name)
-    fd = os.open(path, os.O_RDWR)
-    try:
-        mm = mmap.mmap(fd, size)
-    finally:
-        os.close(fd)
-    return memoryview(mm)
+def attach_arena(name: str) -> memoryview:
+    """Client-side: mmap a node's slab once; cached for process lifetime."""
+    view = _arena_cache.get(name)
+    if view is None:
+        path = shm_path(name)
+        size = os.path.getsize(path)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        view = memoryview(mm)
+        _arena_cache[name] = view
+    return view
 
 
-def unlink_segment(name: str) -> None:
-    try:
-        os.unlink(shm_path(name))
-    except FileNotFoundError:
-        pass
-
-
-def segment_name(node_hex: str, obj: ObjectID) -> str:
-    return f"raytpu-{node_hex[:8]}-{obj.hex()}"
+def attach_extent(name: str, offset: int, size: int) -> memoryview:
+    """Client-side zero-copy view of one object's extent."""
+    return attach_arena(name)[offset : offset + size]
 
 
 # Entry locations
@@ -83,16 +99,15 @@ class Entry:
     size: int
     sealed: bool = False
     data: bytes | None = None          # INLINE
-    shm_name: str | None = None        # SHM
+    offset: int | None = None          # SHM: extent offset in the slab
     spill_path: str | None = None      # SPILLED
-    pins: int = 0                      # active readers / creators
+    pins: int = 0                      # live zero-copy readers
+    doomed: bool = False               # freed while pinned; release at pins==0
     last_used: float = field(default_factory=time.monotonic)
-    # mmap views held by the store itself (for transfer serving)
-    _view: memoryview | None = None
 
 
 class LocalObjectStore:
-    """Authoritative per-node store metadata + spill/evict engine.
+    """Authoritative per-node store: slab allocator + spill/evict engine.
 
     Runs inside the node daemon's asyncio loop; all methods are
     single-threaded coroutine-safe.
@@ -104,9 +119,20 @@ class LocalObjectStore:
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
         self.entries: dict[ObjectID, Entry] = {}
-        self.shm_bytes = 0
         self._seal_events: dict[ObjectID, asyncio.Event] = {}
+        self._restoring: dict[ObjectID, asyncio.Task] = {}
+        # Extents freed-while-pinned whose ObjectID was since re-created:
+        # kept until their readers disconnect (see create()).
+        self._zombies: list[tuple[ObjectID, Entry]] = []
         self.capacity = config.object_store_memory
+        sweep_stale_arenas()
+        self.arena_name = f"raytpu-arena-{node_hex[:16]}-{os.getpid()}"
+        self.arena = ArenaAllocator(shm_path(self.arena_name), self.capacity)
+        self._view = attach_arena(self.arena_name)
+
+    @property
+    def shm_bytes(self) -> int:
+        return self.arena.used
 
     # ---- creation ----
 
@@ -118,21 +144,21 @@ class LocalObjectStore:
         )
         self._wake(obj_id)
 
-    async def create(self, obj_id: ObjectID, size: int) -> str:
-        """Reserve a segment for a client to fill; returns shm name."""
+    async def create(self, obj_id: ObjectID, size: int) -> tuple[str, int]:
+        """Reserve an extent for a client to fill; returns (slab name, offset)."""
         if obj_id in self.entries:
             e = self.entries[obj_id]
-            if e.location == SHM and not e.sealed:
-                return e.shm_name  # idempotent re-create
-            raise KeyError(f"{obj_id} already exists")
-        await self._ensure_space(size)
-        name = segment_name(self.node_hex, obj_id)
-        view = create_segment(name, size)
-        self.entries[obj_id] = Entry(
-            location=SHM, size=size, shm_name=name, _view=view
-        )
-        self.shm_bytes += size
-        return name
+            if e.doomed:
+                # Freed while readers still hold views; park the old extent
+                # until its pins drop (unpin scans zombies) and re-create.
+                self._zombies.append((obj_id, self.entries.pop(obj_id)))
+            elif e.location == SHM and not e.sealed:
+                return self.arena_name, e.offset  # idempotent re-create
+            else:
+                raise KeyError(f"{obj_id} already exists")
+        offset = await self._alloc(size)
+        self.entries[obj_id] = Entry(location=SHM, size=size, offset=offset)
+        return self.arena_name, offset
 
     def seal(self, obj_id: ObjectID) -> None:
         e = self.entries[obj_id]
@@ -149,7 +175,7 @@ class LocalObjectStore:
 
     def contains(self, obj_id: ObjectID) -> bool:
         e = self.entries.get(obj_id)
-        return e is not None and e.sealed
+        return e is not None and e.sealed and not e.doomed
 
     async def wait_sealed(self, obj_id: ObjectID, timeout: float | None) -> bool:
         if self.contains(obj_id):
@@ -161,20 +187,62 @@ class LocalObjectStore:
         except asyncio.TimeoutError:
             return False
 
-    async def describe(self, obj_id: ObjectID) -> tuple[str, Any]:
-        """→ ("inline", bytes) | ("shm", (name, size)). Restores spills."""
+    async def describe(self, obj_id: ObjectID, pin: bool = False):
+        """→ ("inline", bytes) | ("shm", (slab, offset, size)). Restores
+        spills. `pin=True` marks a live zero-copy reader: the extent must not
+        be spilled/moved under the reader's mmap (plasma client-ref model)."""
         e = self.entries[obj_id]
+        if e.doomed:
+            raise KeyError(f"{obj_id} was freed")
         e.last_used = time.monotonic()
         if e.location == INLINE:
             return INLINE, e.data
         if e.location == SPILLED:
-            await self._restore(obj_id, e)
-        return SHM, (e.shm_name, e.size)
+            # Single-flight restore: concurrent readers of a spilled object
+            # share one restore task (double-restore would leak an extent and
+            # unlink the spill file twice). The restore itself holds a pin so
+            # a concurrent free() defers instead of unlinking mid-read.
+            e.pins += 1
+            try:
+                t = self._restoring.get(obj_id)
+                if t is None:
+                    t = asyncio.ensure_future(self._restore(obj_id, e))
+                    self._restoring[obj_id] = t
+                    t.add_done_callback(
+                        lambda _t: self._restoring.pop(obj_id, None))
+                await asyncio.shield(t)
+            finally:
+                self.pin(obj_id, -1)  # releases now if freed during restore
+            if e.doomed:
+                raise KeyError(f"{obj_id} was freed")
+        if pin:
+            e.pins += 1
+        return SHM, (self.arena_name, e.offset, e.size)
 
     def pin(self, obj_id: ObjectID, delta: int = 1) -> None:
         e = self.entries.get(obj_id)
         if e is not None:
             e.pins = max(0, e.pins + delta)
+            if e.pins == 0 and e.doomed:
+                self._release(obj_id, e)
+
+    def unpin(self, obj_id: ObjectID) -> None:
+        """Release one reader pin. Zombie extents (freed + re-created while
+        pinned) are drained first — their pins are the older ones."""
+        for i, (zid, ze) in enumerate(self._zombies):
+            if zid == obj_id and ze.pins > 0:
+                ze.pins -= 1
+                if ze.pins == 0:
+                    self._free_extent(ze)
+                    self._zombies.pop(i)
+                return
+        self.pin(obj_id, -1)
+
+    def write_bytes(self, obj_id: ObjectID, offset: int, data: bytes) -> None:
+        """Daemon-side fill of an unsealed extent (node-to-node pull path)."""
+        e = self.entries[obj_id]
+        base = e.offset + offset
+        self._view[base : base + len(data)] = data
 
     def read_bytes(self, obj_id: ObjectID, offset: int, length: int) -> bytes:
         """For node-to-node transfer serving (chunked)."""
@@ -185,64 +253,72 @@ class LocalObjectStore:
             with open(e.spill_path, "rb") as f:
                 f.seek(offset)
                 return f.read(length)
-        view = e._view
-        if view is None:
-            view = attach_segment(e.shm_name, e.size)
-            e._view = view
-        return bytes(view[offset : offset + length])
+        base = e.offset + offset
+        return bytes(self._view[base : base + length])
 
     # ---- delete / evict / spill ----
 
     def free(self, obj_id: ObjectID) -> None:
-        e = self.entries.pop(obj_id, None)
+        """Logically delete. If readers still hold zero-copy views (pins>0)
+        the extent is kept until the last unpin so their memory can't be
+        reused under them (plasma's client-reference semantics)."""
+        e = self.entries.get(obj_id)
         if e is None:
             return
+        if e.pins > 0:
+            e.doomed = True
+            return
+        self._release(obj_id, e)
+
+    def _release(self, obj_id: ObjectID, e: Entry) -> None:
+        self.entries.pop(obj_id, None)
+        self._free_extent(e)
+
+    def _free_extent(self, e: Entry) -> None:
         if e.location == SHM:
-            self.shm_bytes -= e.size
-            if e._view is not None:
-                e._view.release()
-            unlink_segment(e.shm_name)
+            self.arena.free(e.offset)
         elif e.location == SPILLED and e.spill_path:
             try:
                 os.unlink(e.spill_path)
             except FileNotFoundError:
                 pass
 
-    async def _ensure_space(self, incoming: int) -> None:
-        """Backpressured creation: spill LRU sealed unpinned objects until the
-        new segment fits (ref: create_request_queue.cc semantics)."""
+    async def _alloc(self, size: int) -> int:
+        """Backpressured allocation: spill LRU sealed unpinned objects until
+        the extent fits (ref: create_request_queue.cc semantics)."""
         limit = int(self.capacity * self.config.object_spill_threshold)
-        if self.shm_bytes + incoming <= limit:
-            return
+        if self.arena.used + size <= limit:
+            offset = self.arena.alloc(size)
+            if offset is not None:
+                return offset
         victims = sorted(
-            (
-                (e.last_used, oid)
-                for oid, e in self.entries.items()
-                if e.location == SHM and e.sealed and e.pins == 0
-            ),
+            (e.last_used, oid)
+            for oid, e in self.entries.items()
+            if e.location == SHM and e.sealed and e.pins == 0
         )
         for _, oid in victims:
-            if self.shm_bytes + incoming <= limit:
-                break
+            if self.arena.used + size <= limit:
+                offset = self.arena.alloc(size)
+                if offset is not None:
+                    return offset
             await self._spill(oid)
-        if self.shm_bytes + incoming > self.capacity:
+        offset = self.arena.alloc(size)
+        if offset is None:
             raise MemoryError(
-                f"object store full: {self.shm_bytes}+{incoming} > {self.capacity}"
+                f"object store full: used={self.arena.used} "
+                f"largest_free={self.arena.largest_free()} want={size}"
             )
+        return offset
 
     async def _spill(self, obj_id: ObjectID) -> None:
         e = self.entries[obj_id]
         path = os.path.join(self.spill_dir, obj_id.hex())
-        view = e._view or attach_segment(e.shm_name, e.size)
-        data = bytes(view)
+        data = bytes(self._view[e.offset : e.offset + e.size])
         await asyncio.to_thread(self._write_file, path, data)
-        view.release()
-        e._view = None
-        unlink_segment(e.shm_name)
-        self.shm_bytes -= e.size
+        self.arena.free(e.offset)
         e.location = SPILLED
         e.spill_path = path
-        e.shm_name = None
+        e.offset = None
         logger.debug("spilled %s (%d bytes)", obj_id.hex()[:12], e.size)
 
     @staticmethod
@@ -253,25 +329,27 @@ class LocalObjectStore:
         os.replace(tmp, path)
 
     async def _restore(self, obj_id: ObjectID, e: Entry) -> None:
-        await self._ensure_space(e.size)
-        name = segment_name(self.node_hex, obj_id)
-        data = await asyncio.to_thread(lambda: open(e.spill_path, "rb").read())
-        view = create_segment(name, e.size)
-        view[:] = data
-        self.shm_bytes += e.size
+        offset = await self._alloc(e.size)
+        try:
+            data = await asyncio.to_thread(
+                lambda: open(e.spill_path, "rb").read())
+        except BaseException:
+            self.arena.free(offset)
+            raise
+        self._view[offset : offset + e.size] = data
         os.unlink(e.spill_path)
         e.location = SHM
-        e.shm_name = name
+        e.offset = offset
         e.spill_path = None
-        e._view = view
 
     # ---- introspection ----
 
     def stats(self) -> dict:
         return {
             "objects": len(self.entries),
-            "shm_bytes": self.shm_bytes,
+            "shm_bytes": self.arena.used,
             "capacity": self.capacity,
+            "native_allocator": self.arena.native,
             "spilled": sum(
                 1 for e in self.entries.values() if e.location == SPILLED
             ),
@@ -280,3 +358,7 @@ class LocalObjectStore:
     def shutdown(self) -> None:
         for oid in list(self.entries):
             self.free(oid)
+        view = _arena_cache.pop(self.arena_name, None)
+        if view is not None:
+            view.release()
+        self.arena.close(unlink=True)

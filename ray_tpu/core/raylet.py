@@ -82,6 +82,7 @@ class Raylet:
                          NodeID(self.node_id).hex()[:8]),
         )
         self.workers: dict[bytes, WorkerHandle] = {}
+        self._conn_pins: dict[int, set] = {}  # conn id → pinned ObjectIDs
         self.lease_queue: list[LeaseRequest] = []
         self.gcs: rpc.Connection | None = None
         self.cluster_view: dict[bytes, dict] = {}
@@ -231,6 +232,10 @@ class Raylet:
         return {"node_id": self.node_id, "ok": True}
 
     def _handle_disconnect(self, conn) -> None:
+        # Release zero-copy read pins held by the departed client (plasma
+        # releases client refs on disconnect the same way).
+        for obj in self._conn_pins.pop(id(conn), ()):
+            self.store.unpin(obj)
         for wid, h in list(self.workers.items()):
             if h.conn is conn:
                 logger.warning("worker %s disconnected", WorkerID(wid).hex()[:8])
@@ -408,8 +413,8 @@ class Raylet:
     # ------------------------------------------------------- object plane
 
     async def _h_store_create(self, conn, p):
-        name = await self.store.create(ObjectID(p["object_id"]), p["size"])
-        return {"shm_name": name}
+        name, offset = await self.store.create(ObjectID(p["object_id"]), p["size"])
+        return {"arena": name, "offset": offset}
 
     async def _h_store_seal(self, conn, p):
         obj = ObjectID(p["object_id"])
@@ -445,7 +450,18 @@ class Raylet:
             if not ok:
                 out.append(("missing", None))
             else:
-                loc, data = await self.store.describe(obj)
+                # Pin: the client holds a zero-copy mmap view — the extent
+                # must not be spilled/moved under it. One pin per (conn,
+                # object); released when the connection drops.
+                pins = self._conn_pins.setdefault(id(conn), set())
+                try:
+                    loc, data = await self.store.describe(
+                        obj, pin=obj not in pins)
+                except KeyError:  # freed concurrently with this get
+                    out.append(("missing", None))
+                    continue
+                if loc == "shm":
+                    pins.add(obj)
                 out.append((loc, data))
         return out
 
@@ -532,10 +548,7 @@ class Raylet:
                     }, timeout=60.0)
                     self.store.put_inline(obj, data)
                 else:
-                    name = await self.store.create(obj, size)
-                    from ray_tpu.core.object_store import attach_segment
-
-                    view = self.store.entries[obj]._view
+                    await self.store.create(obj, size)
                     off = 0
                     while off < size:
                         n = min(chunk, size - off)
@@ -545,7 +558,7 @@ class Raylet:
                         }, timeout=60.0)
                         if data is None:
                             raise rpc.RpcError("holder dropped object mid-pull")
-                        view[off:off + n] = data
+                        self.store.write_bytes(obj, off, data)
                         off += n
                     self.store.seal(obj)
                 await self.gcs.call("obj_loc_add", {

@@ -252,9 +252,9 @@ class Worker:
                 resp = await self.raylet.call("store_create", {
                     "object_id": obj_id, "size": size,
                 })
-                from ray_tpu.core.object_store import attach_segment
+                from ray_tpu.core.object_store import attach_extent
 
-                view = attach_segment(resp["shm_name"], size)
+                view = attach_extent(resp["arena"], resp["offset"], size)
                 serialization.write_to(view, head, views)
                 view.release()
                 await self.raylet.call("store_seal", {"object_id": obj_id})

@@ -44,6 +44,21 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _block_mask(iq, ik, *, causal, kv_len, block_q, block_kv):
+    """[bq, bk] validity mask for one (q block, kv block) tile: in-range kv
+    columns, and q >= kv when causal. Shared by fwd/dq/dkv kernels."""
+    kpos = ik * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1
+    )
+    mask = kpos < kv_len
+    if causal:
+        qpos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        mask = jnp.logical_and(mask, qpos >= kpos)
+    return mask
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
@@ -69,15 +84,8 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [bq, bk]
 
-        kpos = ik * block_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1
-        )
-        mask = kpos < kv_len
-        if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            mask = jnp.logical_and(mask, qpos >= kpos)
+        mask = _block_mask(iq, ik, causal=causal, kv_len=kv_len,
+                           block_q=block_q, block_kv=block_kv)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[...]                       # [bq, LANES] (uniform rows)
@@ -181,16 +189,8 @@ def _dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        kpos = ik * block_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1
-        )
-        mask = kpos < kv_len
-        if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            mask = jnp.logical_and(mask, qpos >= kpos)
-        s = jnp.where(mask, s, NEG_INF)
+        mask = _block_mask(iq, ik, causal=causal, kv_len=kv_len,
+                           block_q=block_q, block_kv=block_kv)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -233,16 +233,8 @@ def _dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
-        kpos = ik * block_kv + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1
-        )
-        mask = kpos < kv_len
-        if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            mask = jnp.logical_and(mask, qpos >= kpos)
-        s = jnp.where(mask, s, NEG_INF)
+        mask = _block_mask(iq, ik, causal=causal, kv_len=kv_len,
+                           block_q=block_q, block_kv=block_kv)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32

@@ -100,8 +100,7 @@ def ring_attention(
         # Rotate first: n-1 rotations total (the held chunk is consumed
         # before the scan; a rotate-last body would pay one wasted ppermute
         # pair per layer since XLA can't drop collectives from a scan body).
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_cur, v_cur = jax.lax.ppermute((k_cur, v_cur), axis_name, perm)
         src = (my - step) % n  # chunk index this device now holds
         if causal:
             case = jnp.where(src < my, 0, 2)  # step >= 1 → never the diagonal

@@ -115,12 +115,12 @@ class TestLocalObjectStore:
 
     def test_shm_create_seal(self, tmp_path):
         async def go():
-            from ray_tpu.core.object_store import attach_segment
+            from ray_tpu.core.object_store import attach_extent
 
             store = self._store(tmp_path)
             obj = ObjectID.from_put(TaskID.for_task(JobID.from_int(1)), 2)
-            name = await store.create(obj, 1024)
-            view = attach_segment(name, 1024)
+            name, offset = await store.create(obj, 1024)
+            view = attach_extent(name, offset, 1024)
             view[:5] = b"abcde"
             view.release()
             assert not store.contains(obj)
@@ -140,7 +140,7 @@ class TestLocalObjectStore:
             objs = []
             for i in range(1, 9):  # 8 × 256 KiB > 0.8 MiB threshold
                 obj = ObjectID.from_put(task, i)
-                name = await store.create(obj, 256 * 1024)
+                await store.create(obj, 256 * 1024)
                 store.seal(obj)
                 objs.append(obj)
             stats = store.stats()
