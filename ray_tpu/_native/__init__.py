@@ -44,6 +44,10 @@ def _compile() -> bool:
         return True
     except Exception as e:  # toolchain missing / compile error
         logger.warning("native build failed, using Python fallback: %s", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

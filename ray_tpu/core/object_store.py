@@ -278,10 +278,7 @@ class LocalObjectStore:
         if e.location == SHM:
             self.arena.free(e.offset)
         elif e.location == SPILLED and e.spill_path:
-            try:
-                os.unlink(e.spill_path)
-            except FileNotFoundError:
-                pass
+            self._unlink_quiet(e.spill_path)
 
     async def _alloc(self, size: int) -> int:
         """Backpressured allocation: spill LRU sealed unpinned objects until
@@ -311,15 +308,42 @@ class LocalObjectStore:
         return offset
 
     async def _spill(self, obj_id: ObjectID) -> None:
-        e = self.entries[obj_id]
+        # Revalidate: state may have changed since victim selection (free,
+        # new reader pin, an earlier victim's spill yielding the loop).
+        e = self.entries.get(obj_id)
+        if (e is None or e.location != SHM or not e.sealed or e.pins > 0
+                or e.doomed):
+            return
         path = os.path.join(self.spill_dir, obj_id.hex())
         data = bytes(self._view[e.offset : e.offset + e.size])
-        await asyncio.to_thread(self._write_file, path, data)
+        # Spill guard pin: a concurrent free() defers (doomed) instead of
+        # double-freeing the extent, and eviction skips this entry.
+        e.pins += 1
+        try:
+            await asyncio.to_thread(self._write_file, path, data)
+        finally:
+            e.pins -= 1
+        if e.doomed:
+            if e.pins == 0:
+                self._release(obj_id, e)
+            self._unlink_quiet(path)
+            return
+        if e.pins > 0:
+            # A reader pinned the extent mid-write; it must stay in shm.
+            self._unlink_quiet(path)
+            return
         self.arena.free(e.offset)
         e.location = SPILLED
         e.spill_path = path
         e.offset = None
         logger.debug("spilled %s (%d bytes)", obj_id.hex()[:12], e.size)
+
+    @staticmethod
+    def _unlink_quiet(path: str) -> None:
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
 
     @staticmethod
     def _write_file(path: str, data: bytes) -> None:

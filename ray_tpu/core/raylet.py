@@ -82,7 +82,10 @@ class Raylet:
                          NodeID(self.node_id).hex()[:8]),
         )
         self.workers: dict[bytes, WorkerHandle] = {}
-        self._conn_pins: dict[int, set] = {}  # conn id → pinned ObjectIDs
+        # conn id → {ObjectID: pin count}. Counted (not deduped): an object
+        # freed + re-created between two gets pins two distinct extents, and
+        # unpin drains zombies before live entries in that same order.
+        self._conn_pins: dict[int, dict] = {}
         self.lease_queue: list[LeaseRequest] = []
         self.gcs: rpc.Connection | None = None
         self.cluster_view: dict[bytes, dict] = {}
@@ -234,8 +237,9 @@ class Raylet:
     def _handle_disconnect(self, conn) -> None:
         # Release zero-copy read pins held by the departed client (plasma
         # releases client refs on disconnect the same way).
-        for obj in self._conn_pins.pop(id(conn), ()):
-            self.store.unpin(obj)
+        for obj, n in self._conn_pins.pop(id(conn), {}).items():
+            for _ in range(n):
+                self.store.unpin(obj)
         for wid, h in list(self.workers.items()):
             if h.conn is conn:
                 logger.warning("worker %s disconnected", WorkerID(wid).hex()[:8])
@@ -451,17 +455,16 @@ class Raylet:
                 out.append(("missing", None))
             else:
                 # Pin: the client holds a zero-copy mmap view — the extent
-                # must not be spilled/moved under it. One pin per (conn,
-                # object); released when the connection drops.
-                pins = self._conn_pins.setdefault(id(conn), set())
+                # must not be spilled/moved under it. Released on explicit
+                # free by this client or when the connection drops.
                 try:
-                    loc, data = await self.store.describe(
-                        obj, pin=obj not in pins)
+                    loc, data = await self.store.describe(obj, pin=True)
                 except KeyError:  # freed concurrently with this get
                     out.append(("missing", None))
                     continue
                 if loc == "shm":
-                    pins.add(obj)
+                    pins = self._conn_pins.setdefault(id(conn), {})
+                    pins[obj] = pins.get(obj, 0) + 1
                 out.append((loc, data))
         return out
 
@@ -470,7 +473,14 @@ class Raylet:
 
     async def _h_store_free(self, conn, p):
         for ob in p["object_ids"]:
-            self.store.free(ObjectID(ob))
+            obj = ObjectID(ob)
+            # The freeing client has released its own views: drop its pins
+            # first so an otherwise-unreferenced extent is reclaimed now
+            # rather than parked doomed until disconnect.
+            pins = self._conn_pins.get(id(conn), {})
+            for _ in range(pins.pop(obj, 0)):
+                self.store.unpin(obj)
+            self.store.free(obj)
             asyncio.ensure_future(self.gcs.call("obj_loc_remove", {
                 "object_id": ob, "node_id": self.node_id,
             }))

@@ -1,1 +1,29 @@
+"""RLlib-equivalent: scalable reinforcement learning on the TPU runtime.
 
+Parity: `/root/reference/rllib/` — Algorithm/AlgorithmConfig driver,
+WorkerSet of rollout actors, policy abstraction, replay buffers, PPO + DQN.
+Compute is functional JAX (jitted sampling + donated SGD steps); rollouts
+are numpy vector envs on host actors.
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.env import (
+    CartPole,
+    Pendulum,
+    VectorEnv,
+    make_env,
+    register_env,
+)
+from ray_tpu.rllib.policy import Policy
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
+    "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",
+    "Pendulum", "make_env", "register_env",
+]

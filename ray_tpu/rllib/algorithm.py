@@ -1,0 +1,160 @@
+"""Algorithm + AlgorithmConfig: the RLlib-equivalent driver API.
+
+Parity: `/root/reference/rllib/algorithms/algorithm.py:147` (`Algorithm.step`
+/ `training_step`) and `algorithm_config.py` (fluent builder:
+`.environment().rollouts().training().resources()`). An Algorithm owns a
+WorkerSet and a jitted learner; `train()` returns a result dict compatible
+with the Tune trainable contract, so `tune.Tuner(PPO, ...)` works unchanged.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.rollout_worker import WorkerSet
+
+
+class AlgorithmConfig:
+    """Fluent, typed config. Subclasses add algorithm-specific fields."""
+
+    def __init__(self):
+        self.env: Any = None
+        self.env_seed = 0
+        self.num_rollout_workers = 0
+        self.num_envs_per_worker = 4
+        self.rollout_fragment_length = 64
+        self.gamma = 0.99
+        self.lr = 5e-4
+        self.train_batch_size = 512
+        self.model_hiddens = (64, 64)
+
+    def environment(self, env, *, seed: int = 0) -> "AlgorithmConfig":
+        self.env = env
+        self.env_seed = seed
+        return self
+
+    def rollouts(self, *, num_rollout_workers: int | None = None,
+                 num_envs_per_worker: int | None = None,
+                 rollout_fragment_length: int | None = None) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)
+
+    algo_class: type | None = None
+
+
+class Algorithm:
+    """Base: owns the WorkerSet; subclasses implement training_step()."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self.workers = WorkerSet(
+            config.env,
+            num_workers=config.num_rollout_workers,
+            num_envs_per_worker=config.num_envs_per_worker,
+            rollout_fragment_length=config.rollout_fragment_length,
+            hiddens=tuple(config.model_hiddens),
+            seed=config.env_seed,
+        )
+        self._timesteps_total = 0
+        self.setup()
+
+    # subclass hooks -------------------------------------------------------
+
+    def setup(self) -> None:
+        pass
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    # public ---------------------------------------------------------------
+
+    def train(self) -> dict:
+        t0 = time.perf_counter()
+        info = self.training_step()
+        self.iteration += 1
+        metrics = self.workers.metrics()
+        returns = [m["episode_return_mean"] for m in metrics
+                   if m["episode_return_mean"] is not None]
+        result = {
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "episode_return_mean": float(np.mean(returns)) if returns else None,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **info,
+        }
+        return result
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, weights) -> None:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> dict:
+        return {"weights": self.get_weights(), "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total}
+
+    def load_checkpoint(self, ckpt: dict) -> None:
+        self.set_weights(ckpt["weights"])
+        self.iteration = ckpt["iteration"]
+        self._timesteps_total = ckpt["timesteps_total"]
+
+    def stop(self) -> None:
+        self.workers.stop()
+
+    # Tune trainable contract ---------------------------------------------
+
+    @classmethod
+    def as_trainable(cls, config_updates: dict | None = None):
+        """Adapter: `tune.Tuner(PPO.as_trainable(), param_space=...)`.
+        The returned function-trainable consumes a dict config whose keys
+        override the default AlgorithmConfig fields and reports each
+        iteration through the shared train/tune session (with a weights
+        checkpoint, so PBT exploit and trial restore work)."""
+        base_cls = cls
+
+        def trainable(config: dict):
+            from ray_tpu.train import session
+
+            cfg = base_cls.get_default_config()
+            for k, v in {**(config_updates or {}), **config}.items():
+                setattr(cfg, k, v)
+            algo = cfg.build()
+            ckpt = session.get_checkpoint()
+            if ckpt is not None:
+                algo.load_checkpoint(ckpt)
+            try:
+                while True:
+                    session.report(algo.train(),
+                                   checkpoint=algo.save_checkpoint())
+            finally:
+                algo.stop()
+
+        return trainable
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        raise NotImplementedError

@@ -1,0 +1,156 @@
+"""DQN: off-policy Q-learning with replay + target network.
+
+Parity: `/root/reference/rllib/algorithms/dqn/` (double-DQN target, epsilon-
+greedy exploration schedule, prioritized replay, target-network sync). The
+Q update is a single jitted step with donated params.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import _init_mlp, _mlp
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size = 50_000
+        self.prioritized_replay = False
+        self.learning_starts = 1000
+        self.target_update_freq = 500     # in sampled timesteps
+        self.double_q = True
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 10_000
+        self.sgd_rounds_per_step = 8
+
+
+class DQN(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> DQNConfig:
+        return DQNConfig()
+
+    def setup(self) -> None:
+        cfg: DQNConfig = self.config
+        env = self.workers.local.env
+        assert env.action_space.discrete, "DQN needs a discrete action space"
+        obs_dim = int(np.prod(env.observation_space.shape))
+        self.n_actions = env.action_space.n
+        sizes = (obs_dim, *cfg.model_hiddens, self.n_actions)
+        self.params = _init_mlp(jax.random.key(cfg.env_seed), sizes,
+                                scale_last=0.01)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        buf_cls = (PrioritizedReplayBuffer if cfg.prioritized_replay
+                   else ReplayBuffer)
+        self.buffer = buf_cls(cfg.buffer_size, seed=cfg.env_seed)
+        self._since_target_sync = 0
+        self._rng = np.random.default_rng(cfg.env_seed)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+        self._qvals = jax.jit(lambda p, o: _mlp(p, o))
+
+    def _epsilon(self) -> float:
+        cfg: DQNConfig = self.config
+        frac = min(1.0, self._timesteps_total / cfg.epsilon_timesteps)
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial)
+
+    def _update_impl(self, params, opt_state, target_params, batch, weights):
+        cfg: DQNConfig = self.config
+
+        def loss_fn(params):
+            q = _mlp(params, batch[sb.OBS])
+            q_taken = jnp.take_along_axis(
+                q, batch[sb.ACTIONS][:, None].astype(jnp.int32), axis=1)[:, 0]
+            q_next_target = _mlp(target_params, batch[sb.NEXT_OBS])
+            if cfg.double_q:
+                q_next_online = _mlp(params, batch[sb.NEXT_OBS])
+                best = jnp.argmax(q_next_online, axis=1)
+            else:
+                best = jnp.argmax(q_next_target, axis=1)
+            q_next = jnp.take_along_axis(
+                q_next_target, best[:, None], axis=1)[:, 0]
+            target = batch[sb.REWARDS] + cfg.gamma * q_next * (
+                1.0 - batch[sb.DONES].astype(jnp.float32))
+            td = q_taken - jax.lax.stop_gradient(target)
+            return jnp.mean(weights * td**2), td
+
+        (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, td
+
+    def training_step(self) -> dict:
+        cfg: DQNConfig = self.config
+        worker = self.workers.local
+        # Epsilon-greedy exploration on top of greedy Q actions.
+        env = worker.env
+        eps = self._epsilon()
+        obs = worker.obs
+        n_steps = cfg.train_batch_size // env.num_envs
+        for _ in range(n_steps):
+            q = np.asarray(self._qvals(self.params, jnp.asarray(obs)))
+            greedy = q.argmax(axis=1)
+            explore = self._rng.random(env.num_envs) < eps
+            actions = np.where(
+                explore, self._rng.integers(0, self.n_actions, env.num_envs),
+                greedy)
+            next_obs, reward, done, trunc = env.step(actions)
+            self.buffer.add(SampleBatch({
+                sb.OBS: obs.astype(np.float32),
+                sb.ACTIONS: actions.astype(np.int64),
+                sb.REWARDS: reward.astype(np.float32),
+                sb.DONES: done,
+                sb.NEXT_OBS: next_obs.astype(np.float32),
+            }))
+            worker._running_return += reward
+            finished = np.logical_or(done, trunc)
+            for i in np.nonzero(finished)[0]:
+                worker.episode_returns.append(float(worker._running_return[i]))
+                worker._running_return[i] = 0.0
+            obs = next_obs
+            self._timesteps_total += env.num_envs
+        worker.obs = obs
+
+        loss = None
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.sgd_rounds_per_step):
+                batch = self.buffer.sample(256)
+                weights = jnp.asarray(batch.get(
+                    "weights", np.ones(batch.count, np.float32)))
+                dev_batch = {k: jnp.asarray(v) for k, v in batch.items()
+                             if k not in ("weights", "batch_indexes")}
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.opt_state, self.target_params,
+                    dev_batch, weights)
+                if cfg.prioritized_replay:
+                    self.buffer.update_priorities(
+                        batch["batch_indexes"], np.asarray(td))
+            self._since_target_sync += cfg.train_batch_size
+            if self._since_target_sync >= cfg.target_update_freq:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+                self._since_target_sync = 0
+        return {"epsilon": eps,
+                "loss": None if loss is None else float(loss),
+                "buffer_size": len(self.buffer)}
+
+    def get_weights(self):
+        return jax.device_get({"params": self.params,
+                               "target": self.target_params})
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights["params"])
+        self.target_params = jax.device_put(weights["target"])
+
+
+DQNConfig.algo_class = DQN

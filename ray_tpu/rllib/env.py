@@ -1,0 +1,168 @@
+"""RL environments: the Env API + built-in vectorized numpy envs.
+
+Parity: the reference wraps gym envs and vectorizes them per rollout worker
+(`/root/reference/rllib/env/vector_env.py`); gym is not a baked-in dependency
+here, so classic-control dynamics are implemented directly in numpy with the
+same observation/action/reward conventions. TPU-first: envs stay on host in
+numpy (cheap scalar dynamics), batched across the vector axis so policy
+inference is one device call per step for all sub-envs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Space:
+    def __init__(self, shape: tuple, dtype, n: int | None = None,
+                 low=None, high=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.n = n          # discrete action count (None = continuous)
+        self.low = low
+        self.high = high
+
+    @property
+    def discrete(self) -> bool:
+        return self.n is not None
+
+
+class VectorEnv:
+    """N independent sub-envs stepped in lockstep with auto-reset.
+
+    Subclasses implement batched `_reset_idx(idx)` and `_step(actions)` over
+    the full vector; `poll()`/`send_actions` style split is unnecessary since
+    stepping is synchronous within a rollout worker.
+    """
+
+    observation_space: Space
+    action_space: Space
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        self.num_envs = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.t = np.zeros(num_envs, np.int32)
+
+    def reset(self) -> np.ndarray:
+        self._reset_idx(np.arange(self.num_envs))
+        self.t[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        """→ (obs, reward, done, truncated). Done sub-envs auto-reset; the
+        returned obs for them is the *new* episode's first obs (the sampler
+        records the pre-reset terminal flags)."""
+        reward, done = self._step(actions)
+        self.t += 1
+        trunc = np.logical_and(self.t >= self.max_steps, ~done)
+        finished = np.logical_or(done, trunc)
+        if finished.any():
+            idx = np.nonzero(finished)[0]
+            self._reset_idx(idx)
+            self.t[idx] = 0
+        return self._obs(), reward, done, trunc
+
+    # subclass hooks
+    max_steps = 1000
+
+    def _reset_idx(self, idx: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def _step(self, actions: np.ndarray):
+        raise NotImplementedError
+
+    def _obs(self) -> np.ndarray:
+        raise NotImplementedError
+
+
+class CartPole(VectorEnv):
+    """Classic cart-pole balancing, identical dynamics/termination to the
+    standard benchmark: reward +1 per step, terminate at |x|>2.4 or
+    |theta|>12deg, truncate at 500 steps."""
+
+    max_steps = 500
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        super().__init__(num_envs, seed)
+        self.observation_space = Space((4,), np.float32)
+        self.action_space = Space((), np.int64, n=2)
+        self.state = np.zeros((num_envs, 4), np.float64)
+        self.reset()
+
+    def _reset_idx(self, idx):
+        self.state[idx] = self.rng.uniform(-0.05, 0.05, (len(idx), 4))
+
+    def _step(self, actions):
+        g, mc, mp, l, fmag, tau = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+        x, xd, th, thd = self.state.T
+        force = np.where(actions == 1, fmag, -fmag)
+        cos, sin = np.cos(th), np.sin(th)
+        tmp = (force + mp * l * thd**2 * sin) / (mc + mp)
+        thacc = (g * sin - cos * tmp) / (l * (4.0 / 3.0 - mp * cos**2 / (mc + mp)))
+        xacc = tmp - mp * l * thacc * cos / (mc + mp)
+        self.state[:, 0] = x + tau * xd
+        self.state[:, 1] = xd + tau * xacc
+        self.state[:, 2] = th + tau * thd
+        self.state[:, 3] = thd + tau * thacc
+        done = np.logical_or(
+            np.abs(self.state[:, 0]) > 2.4,
+            np.abs(self.state[:, 2]) > 12 * np.pi / 180,
+        )
+        return np.ones(self.num_envs, np.float32), done
+
+    def _obs(self):
+        return self.state.astype(np.float32)
+
+
+class Pendulum(VectorEnv):
+    """Torque-controlled pendulum swing-up (continuous actions in [-2, 2])."""
+
+    max_steps = 200
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        super().__init__(num_envs, seed)
+        self.observation_space = Space((3,), np.float32)
+        self.action_space = Space((1,), np.float32, low=-2.0, high=2.0)
+        self.th = np.zeros(num_envs)
+        self.thd = np.zeros(num_envs)
+        self.reset()
+
+    def _reset_idx(self, idx):
+        self.th[idx] = self.rng.uniform(-np.pi, np.pi, len(idx))
+        self.thd[idx] = self.rng.uniform(-1.0, 1.0, len(idx))
+
+    def _step(self, actions):
+        g, m, l, dt = 10.0, 1.0, 1.0, 0.05
+        u = np.clip(np.asarray(actions).reshape(self.num_envs), -2.0, 2.0)
+        th_norm = ((self.th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm**2 + 0.1 * self.thd**2 + 0.001 * u**2
+        self.thd = np.clip(
+            self.thd + (3 * g / (2 * l) * np.sin(self.th) + 3.0 / (m * l**2) * u) * dt,
+            -8.0, 8.0,
+        )
+        self.th = self.th + self.thd * dt
+        return (-cost).astype(np.float32), np.zeros(self.num_envs, bool)
+
+    def _obs(self):
+        return np.stack(
+            [np.cos(self.th), np.sin(self.th), self.thd], axis=1
+        ).astype(np.float32)
+
+
+_ENVS = {"CartPole-v1": CartPole, "Pendulum-v1": Pendulum}
+
+
+def register_env(name: str, cls) -> None:
+    _ENVS[name] = cls
+
+
+def make_env(name_or_cls, num_envs: int, seed: int = 0) -> VectorEnv:
+    if isinstance(name_or_cls, str):
+        cls = _ENVS.get(name_or_cls)
+        if cls is None:
+            raise KeyError(
+                f"unknown env {name_or_cls!r}; register with register_env()"
+            )
+    else:
+        cls = name_or_cls
+    return cls(num_envs=num_envs, seed=seed)

@@ -1,0 +1,118 @@
+"""JAX actor-critic policy.
+
+Parity: the reference's `Policy` abstraction (`/root/reference/rllib/policy/
+torch_policy_v2.py` — compute_actions / loss / learn_on_batch); here a single
+functional-JAX implementation replaces the torch/tf pair. Params are plain
+pytrees (same style as ray_tpu.models.gpt); the sampling path and the SGD
+step are both jitted, and the SGD step is donated so params update in place
+on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.env import Space
+
+
+def _init_mlp(key, sizes, scale_last=0.01):
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        scale = scale_last if i == len(sizes) - 2 else np.sqrt(2.0 / fan_in)
+        params.append({
+            "w": jax.random.normal(sub, (fan_in, fan_out), jnp.float32) * scale,
+            "b": jnp.zeros((fan_out,), jnp.float32),
+        })
+    return params
+
+
+def _mlp(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class Policy:
+    """Actor-critic with categorical (discrete) or diagonal-gaussian
+    (continuous) action head and a separate value MLP."""
+
+    def __init__(self, obs_space: Space, action_space: Space,
+                 hiddens=(64, 64), seed: int = 0):
+        self.obs_space = obs_space
+        self.action_space = action_space
+        self.discrete = action_space.discrete
+        obs_dim = int(np.prod(obs_space.shape))
+        act_dim = action_space.n if self.discrete else int(
+            np.prod(action_space.shape))
+        key = jax.random.key(seed)
+        kp, kv = jax.random.split(key)
+        self.params = {
+            "pi": _init_mlp(kp, (obs_dim, *hiddens, act_dim)),
+            "vf": _init_mlp(kv, (obs_dim, *hiddens, 1), scale_last=1.0),
+        }
+        if not self.discrete:
+            self.params["log_std"] = jnp.zeros((act_dim,), jnp.float32)
+        self._sample = jax.jit(self._sample_impl)
+
+    # ---- distributions ----
+
+    def _dist(self, params, obs):
+        logits = _mlp(params["pi"], obs)
+        if self.discrete:
+            return logits, None
+        return logits, jnp.exp(params["log_std"])
+
+    def _logp(self, params, obs, actions):
+        mean_or_logits, std = self._dist(params, obs)
+        if self.discrete:
+            logp_all = jax.nn.log_softmax(mean_or_logits)
+            return jnp.take_along_axis(
+                logp_all, actions[:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+        d = (actions - mean_or_logits) / std
+        return -0.5 * jnp.sum(d * d + 2 * jnp.log(std) + jnp.log(2 * jnp.pi),
+                              axis=-1)
+
+    def _entropy(self, params, obs):
+        mean_or_logits, std = self._dist(params, obs)
+        if self.discrete:
+            logp = jax.nn.log_softmax(mean_or_logits)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return jnp.sum(jnp.log(std) + 0.5 * jnp.log(2 * jnp.pi * jnp.e))
+
+    def value(self, params, obs):
+        return _mlp(params["vf"], obs)[:, 0]
+
+    def _sample_impl(self, params, obs, key):
+        mean_or_logits, std = self._dist(params, obs)
+        vf = self.value(params, obs)
+        if self.discrete:
+            actions = jax.random.categorical(key, mean_or_logits)
+            logp_all = jax.nn.log_softmax(mean_or_logits)
+            logp = jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+        else:
+            eps = jax.random.normal(key, mean_or_logits.shape)
+            actions = mean_or_logits + std * eps
+            logp = self._logp(params, obs, actions)
+        return actions, logp, vf
+
+    # ---- public API ----
+
+    def compute_actions(self, obs: np.ndarray, key) -> tuple:
+        """→ (actions, logp, vf_preds) as numpy."""
+        a, lp, vf = self._sample(self.params, jnp.asarray(obs), key)
+        return np.asarray(a), np.asarray(lp), np.asarray(vf)
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)

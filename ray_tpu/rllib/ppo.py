@@ -1,0 +1,137 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Parity: `/root/reference/rllib/algorithms/ppo/` (clip objective, GAE,
+minibatch SGD epochs, entropy bonus, vf clipping). TPU-first: the whole SGD
+epoch — all minibatches — runs as one jitted `lax.scan` with donated params,
+so an iteration is a single device dispatch regardless of minibatch count.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae, flatten_time_major
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.num_sgd_iter = 8
+        self.sgd_minibatch_size = 128
+        self.lambda_ = 0.95
+        self.grad_clip = 0.5
+
+
+class PPO(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> PPOConfig:
+        return PPOConfig()
+
+    def setup(self) -> None:
+        cfg: PPOConfig = self.config
+        self.policy = self.workers.local.policy
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr),
+        )
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self._rng = np.random.default_rng(cfg.env_seed)
+        self._sgd_step = jax.jit(self._sgd_epoch, donate_argnums=(0, 1))
+
+    # ---- loss ----
+
+    def _loss(self, params, batch):
+        cfg: PPOConfig = self.config
+        pol = self.policy
+        logp = pol._logp(params, batch[sb.OBS], batch[sb.ACTIONS])
+        ratio = jnp.exp(logp - batch[sb.LOGP])
+        adv = batch[sb.ADVANTAGES]
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv,
+        )
+        vf = pol.value(params, batch[sb.OBS])
+        vf_err = jnp.clip(
+            vf - batch[sb.VALUE_TARGETS], -cfg.vf_clip_param, cfg.vf_clip_param
+        )
+        vf_loss = jnp.mean(vf_err**2)
+        entropy = jnp.mean(pol._entropy(params, batch[sb.OBS]))
+        loss = (-jnp.mean(surr) + cfg.vf_loss_coeff * vf_loss
+                - cfg.entropy_coeff * entropy)
+        return loss, {"policy_loss": -jnp.mean(surr), "vf_loss": vf_loss,
+                      "entropy": entropy}
+
+    def _sgd_epoch(self, params, opt_state, minibatches):
+        """minibatches: pytree of [n_mb, mb_size, ...] arrays; one scan over
+        minibatches = one device dispatch per epoch."""
+
+        def step(carry, mb):
+            params, opt_state = carry
+            (loss, info), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(params, mb)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), (loss, info)
+
+        (params, opt_state), (losses, infos) = jax.lax.scan(
+            step, (params, opt_state), minibatches)
+        return params, opt_state, losses, infos
+
+    # ---- training step ----
+
+    def training_step(self) -> dict:
+        cfg: PPOConfig = self.config
+        self.workers.sync_weights(self.policy.get_weights())
+        batches = self.workers.sample()
+        # GAE per worker fragment (time-major), then flatten + concat.
+        flat = []
+        for b in batches:
+            last_values = b.pop("last_values")
+            flat.append(flatten_time_major(
+                compute_gae(b, last_values, gamma=cfg.gamma, lam=cfg.lambda_)))
+        train_batch = SampleBatch.concat(flat)
+        self._timesteps_total += train_batch.count
+
+        adv = train_batch[sb.ADVANTAGES]
+        train_batch[sb.ADVANTAGES] = (
+            (adv - adv.mean()) / max(1e-8, adv.std())).astype(np.float32)
+
+        mb = cfg.sgd_minibatch_size
+        n_mb = max(1, train_batch.count // mb)
+        losses = None
+        for _ in range(cfg.num_sgd_iter):
+            shuffled = train_batch.shuffle(self._rng)
+            stacked = {
+                k: jnp.asarray(v[: n_mb * mb].reshape((n_mb, mb) + v.shape[1:]))
+                for k, v in shuffled.items()
+            }
+            self.policy.params, self.opt_state, losses, infos = self._sgd_step(
+                self.policy.params, self.opt_state, stacked)
+        return {
+            "total_loss": float(jnp.mean(losses)),
+            "policy_loss": float(jnp.mean(infos["policy_loss"])),
+            "vf_loss": float(jnp.mean(infos["vf_loss"])),
+            "entropy": float(jnp.mean(infos["entropy"])),
+        }
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+
+PPOConfig.algo_class = PPO

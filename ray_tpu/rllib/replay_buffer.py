@@ -1,0 +1,82 @@
+"""Replay buffers for off-policy algorithms.
+
+Parity: `/root/reference/rllib/utils/replay_buffers/` (ReplayBuffer +
+PrioritizedReplayBuffer with segment-tree sampling). Storage is preallocated
+columnar numpy (ring buffer) so sampling a batch is one fancy-index per
+column — no per-transition Python objects.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class ReplayBuffer:
+    """Uniform ring-buffer replay."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self.rng = np.random.default_rng(seed)
+        self._cols: dict[str, np.ndarray] | None = None
+        self._next = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, batch: SampleBatch) -> None:
+        n = batch.count
+        if self._cols is None:
+            self._cols = {
+                k: np.zeros((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in batch.items()
+            }
+        idx = (self._next + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._cols[k][idx] = v
+        self._next = (self._next + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, batch_size: int) -> SampleBatch:
+        idx = self.rng.integers(0, self._size, batch_size)
+        return SampleBatch({k: v[idx] for k, v in self._cols.items()})
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritization (alpha) with importance weights (beta).
+
+    A flat priority array + cumsum sampling replaces the reference's segment
+    tree: for buffer sizes used here (<=1e6) a vectorized cumsum draw is
+    simpler and fast enough in numpy.
+    """
+
+    def __init__(self, capacity: int, *, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._prio = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+
+    def add(self, batch: SampleBatch) -> None:
+        n = batch.count
+        idx = (self._next + np.arange(n)) % self.capacity
+        super().add(batch)
+        self._prio[idx] = self._max_prio**self.alpha
+
+    def sample(self, batch_size: int) -> SampleBatch:
+        p = self._prio[: self._size]
+        probs = p / p.sum()
+        idx = self.rng.choice(self._size, batch_size, p=probs)
+        weights = (self._size * probs[idx]) ** (-self.beta)
+        out = SampleBatch({k: v[idx] for k, v in self._cols.items()})
+        out["weights"] = (weights / weights.max()).astype(np.float32)
+        out["batch_indexes"] = idx.astype(np.int64)
+        return out
+
+    def update_priorities(self, idx: np.ndarray, td_errors: np.ndarray) -> None:
+        prio = np.abs(td_errors) + 1e-6
+        self._prio[idx] = prio**self.alpha
+        self._max_prio = max(self._max_prio, float(prio.max()))

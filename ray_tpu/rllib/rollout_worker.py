@@ -1,0 +1,141 @@
+"""RolloutWorker + WorkerSet: distributed experience collection.
+
+Parity: `/root/reference/rllib/evaluation/rollout_worker.py` (env sampling
+with a local policy copy) and `rllib/evaluation/worker_set.py` (local worker
++ N remote actor workers, weight broadcast, fault-tolerant sampling). Remote
+workers are ray_tpu actors; `sample()` returns a time-major SampleBatch so
+GAE runs vectorized on the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.policy import Policy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class RolloutWorker:
+    """Samples fixed-length fragments from a vectorized env with the current
+    policy weights. Runs as an actor (remote) or in-process (local mode)."""
+
+    def __init__(self, env: Any, *, num_envs: int = 1, seed: int = 0,
+                 hiddens=(64, 64), rollout_fragment_length: int = 64,
+                 jax_platform: str | None = None):
+        # Remote samplers run their small policy MLP on host CPU: per-step
+        # inference on tiny batches would be dominated by TPU dispatch
+        # latency, and the TPU belongs to the learner. Must happen before
+        # this process's JAX backend initializes.
+        if jax_platform is not None:
+            jax.config.update("jax_platforms", jax_platform)
+        self.env = make_env(env, num_envs=num_envs, seed=seed)
+        self.policy = Policy(
+            self.env.observation_space, self.env.action_space,
+            hiddens=hiddens, seed=seed,
+        )
+        self.fragment = rollout_fragment_length
+        self.key = jax.random.key(seed)
+        self.obs = self.env.reset()
+        self.episode_returns: list[float] = []
+        self._running_return = np.zeros(self.env.num_envs, np.float32)
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def sample(self) -> SampleBatch:
+        """One [T, N] fragment. Also records completed-episode returns."""
+        T, N = self.fragment, self.env.num_envs
+        cols = {
+            sb.OBS: np.zeros((T, N) + self.env.observation_space.shape,
+                             np.float32),
+            sb.ACTIONS: None,
+            sb.REWARDS: np.zeros((T, N), np.float32),
+            sb.DONES: np.zeros((T, N), bool),
+            sb.TRUNCS: np.zeros((T, N), bool),
+            sb.LOGP: np.zeros((T, N), np.float32),
+            sb.VF_PREDS: np.zeros((T, N), np.float32),
+        }
+        for t in range(T):
+            self.key, sub = jax.random.split(self.key)
+            actions, logp, vf = self.policy.compute_actions(self.obs, sub)
+            cols[sb.OBS][t] = self.obs
+            if cols[sb.ACTIONS] is None:
+                cols[sb.ACTIONS] = np.zeros((T,) + actions.shape,
+                                            actions.dtype)
+            cols[sb.ACTIONS][t] = actions
+            cols[sb.LOGP][t] = logp
+            cols[sb.VF_PREDS][t] = vf
+            self.obs, reward, done, trunc = self.env.step(actions)
+            cols[sb.REWARDS][t] = reward
+            cols[sb.DONES][t] = done
+            cols[sb.TRUNCS][t] = trunc
+            self._running_return += reward
+            finished = np.logical_or(done, trunc)
+            for i in np.nonzero(finished)[0]:
+                self.episode_returns.append(float(self._running_return[i]))
+                self._running_return[i] = 0.0
+        # Bootstrap values for the state after the fragment.
+        self.key, sub = jax.random.split(self.key)
+        _, _, last_vf = self.policy.compute_actions(self.obs, sub)
+        batch = SampleBatch(cols)
+        batch["last_values"] = last_vf
+        return batch
+
+    def metrics(self, window: int = 100) -> dict:
+        recent = self.episode_returns[-window:]
+        return {
+            "episodes_total": len(self.episode_returns),
+            "episode_return_mean": float(np.mean(recent)) if recent else None,
+        }
+
+
+class WorkerSet:
+    """A local worker (learner-side, also used when num_workers=0) plus N
+    remote rollout actors."""
+
+    def __init__(self, env, *, num_workers: int = 0, num_envs_per_worker: int = 1,
+                 rollout_fragment_length: int = 64, hiddens=(64, 64),
+                 seed: int = 0):
+        self.local = RolloutWorker(
+            env, num_envs=num_envs_per_worker, seed=seed, hiddens=hiddens,
+            rollout_fragment_length=rollout_fragment_length,
+        )
+        self.remote_workers = []
+        if num_workers > 0:
+            actor_cls = ray_tpu.remote(RolloutWorker)
+            self.remote_workers = [
+                actor_cls.remote(
+                    env, num_envs=num_envs_per_worker, seed=seed + 1 + i,
+                    hiddens=hiddens,
+                    rollout_fragment_length=rollout_fragment_length,
+                    jax_platform="cpu",
+                )
+                for i in range(num_workers)
+            ]
+
+    def sync_weights(self, weights) -> None:
+        self.local.set_weights(weights)
+        if self.remote_workers:
+            ray_tpu.get([w.set_weights.remote(weights)
+                         for w in self.remote_workers])
+
+    def sample(self) -> list[SampleBatch]:
+        """One fragment per worker, collected in parallel."""
+        if not self.remote_workers:
+            return [self.local.sample()]
+        return ray_tpu.get([w.sample.remote() for w in self.remote_workers])
+
+    def metrics(self) -> list[dict]:
+        if not self.remote_workers:
+            return [self.local.metrics()]
+        return ray_tpu.get([w.metrics.remote() for w in self.remote_workers])
+
+    def stop(self) -> None:
+        for w in self.remote_workers:
+            ray_tpu.kill(w)

@@ -1,0 +1,94 @@
+"""SampleBatch: columnar rollout data + advantage estimation.
+
+Parity: `/root/reference/rllib/policy/sample_batch.py` (dict-of-arrays with
+concat/shuffle/minibatch) and GAE postprocessing
+(`rllib/evaluation/postprocessing.py`). Host-side numpy; batches move to
+device once per SGD epoch as a single stacked transfer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+TRUNCS = "truncs"
+NEXT_OBS = "next_obs"
+LOGP = "logp"
+VF_PREDS = "vf_preds"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+
+
+class SampleBatch(dict):
+    """A dict of equally-sized numpy arrays keyed by column name."""
+
+    @property
+    def count(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @staticmethod
+    def concat(batches: "list[SampleBatch]") -> "SampleBatch":
+        keys = batches[0].keys()
+        return SampleBatch(
+            {k: np.concatenate([b[k] for b in batches]) for k in keys}
+        )
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        perm = rng.permutation(self.count)
+        return SampleBatch({k: v[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int):
+        n = self.count
+        for i in range(0, n - size + 1, size):
+            yield SampleBatch({k: v[i : i + size] for k, v in self.items()})
+
+
+def compute_gae(
+    batch: SampleBatch,
+    last_values: np.ndarray,
+    *,
+    gamma: float = 0.99,
+    lam: float = 0.95,
+) -> SampleBatch:
+    """Generalized advantage estimation over a [T, N] time-major rollout.
+
+    `batch` columns are [T, N] (T steps, N vector sub-envs); `last_values`
+    [N] bootstraps the value beyond the rollout horizon. Episode boundaries:
+    `dones` cut the bootstrap to 0; `truncs` bootstrap through the recorded
+    next-state value (standard time-limit handling).
+    """
+    rewards = batch[REWARDS]
+    dones = batch[DONES].astype(np.float32)
+    vf = batch[VF_PREDS]
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    next_v = last_values.astype(np.float32)
+    gae = np.zeros(N, np.float32)
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        # Truncated (time-limit) steps also stop the GAE recursion but keep
+        # the bootstrap value; `bootstrap_values` column carries v(s_{t+1}).
+        if TRUNCS in batch:
+            cut = np.logical_or(batch[DONES][t], batch[TRUNCS][t])
+        else:
+            cut = batch[DONES][t]
+        delta = rewards[t] + gamma * next_v * nonterminal - vf[t]
+        gae = delta + gamma * lam * nonterminal * np.where(cut, 0.0, gae)
+        adv[t] = gae
+        next_v = vf[t]
+    out = SampleBatch(batch)
+    out[ADVANTAGES] = adv
+    out[VALUE_TARGETS] = adv + vf
+    return out
+
+
+def flatten_time_major(batch: SampleBatch) -> SampleBatch:
+    """[T, N, ...] → [T*N, ...] for SGD."""
+    return SampleBatch(
+        {k: v.reshape((-1,) + v.shape[2:]) for k, v in batch.items()}
+    )

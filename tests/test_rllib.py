@@ -1,0 +1,199 @@
+"""RLlib-equivalent: envs, GAE, replay buffers, PPO/DQN learning,
+distributed rollout workers.
+
+Mirrors the reference's per-algorithm learning tests
+(`/root/reference/rllib/algorithms/*/tests/` run a few iterations and assert
+reward improvement) at CI scale.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    CartPole,
+    DQNConfig,
+    Pendulum,
+    PPOConfig,
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    SampleBatch,
+    compute_gae,
+)
+from ray_tpu.rllib import sample_batch as sb
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestDistributedRollouts:
+    def test_remote_workers_sample_and_sync(self, cluster):
+        cfg = (PPOConfig()
+               .environment("CartPole-v1", seed=0)
+               .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                         rollout_fragment_length=32)
+               .training(num_sgd_iter=2, sgd_minibatch_size=64))
+        algo = cfg.build()
+        r1 = algo.train()
+        r2 = algo.train()
+        assert r2["timesteps_total"] == 2 * 2 * 2 * 32  # workers*envs*frag*it
+        assert np.isfinite(r2["total_loss"])
+        algo.stop()
+
+
+class TestEnvs:
+    def test_cartpole_basics(self):
+        env = CartPole(num_envs=4, seed=0)
+        obs = env.reset()
+        assert obs.shape == (4, 4)
+        total_done = 0
+        for _ in range(300):
+            obs, r, done, trunc = env.step(np.random.randint(0, 2, 4))
+            assert r.shape == (4,) and (r == 1.0).all()
+            total_done += done.sum()
+        assert total_done > 0  # random policy falls over within 300 steps
+
+    def test_pendulum_rewards_negative(self):
+        env = Pendulum(num_envs=2, seed=0)
+        env.reset()
+        _, r, done, _ = env.step(np.zeros((2, 1), np.float32))
+        assert (r <= 0).all() and not done.any()
+
+    def test_auto_reset_keeps_episodes_bounded(self):
+        env = CartPole(num_envs=1, seed=0)
+        env.reset()
+        for _ in range(1200):
+            _, _, _, trunc = env.step(np.zeros(1, np.int64))
+        assert env.t[0] <= env.max_steps
+
+
+class TestGAE:
+    def test_matches_manual_single_env(self):
+        T = 4
+        batch = SampleBatch({
+            sb.REWARDS: np.array([[1.0], [1.0], [1.0], [1.0]], np.float32),
+            sb.DONES: np.array([[False], [False], [False], [True]]),
+            sb.VF_PREDS: np.array([[0.5], [0.5], [0.5], [0.5]], np.float32),
+        })
+        out = compute_gae(batch, np.array([9.9], np.float32),
+                          gamma=0.9, lam=0.8)
+        # Manual backward recursion (terminal cuts the bootstrap).
+        adv = np.zeros(T)
+        gae, next_v = 0.0, 9.9
+        for t in range(T - 1, -1, -1):
+            nt = 0.0 if batch[sb.DONES][t, 0] else 1.0
+            delta = 1.0 + 0.9 * next_v * nt - 0.5
+            gae = delta + 0.9 * 0.8 * nt * gae
+            adv[t] = gae
+            next_v = 0.5
+        np.testing.assert_allclose(out[sb.ADVANTAGES][:, 0], adv, rtol=1e-5)
+        np.testing.assert_allclose(
+            out[sb.VALUE_TARGETS], out[sb.ADVANTAGES] + 0.5, rtol=1e-5)
+
+    def test_truncation_stops_recursion_but_bootstraps(self):
+        batch = SampleBatch({
+            sb.REWARDS: np.ones((3, 1), np.float32),
+            sb.DONES: np.zeros((3, 1), bool),
+            sb.TRUNCS: np.array([[False], [True], [False]]),
+            sb.VF_PREDS: np.full((3, 1), 0.5, np.float32),
+        })
+        out = compute_gae(batch, np.zeros(1, np.float32), gamma=1.0, lam=1.0)
+        # Step 1 truncated: the chain from step 2 (a new episode) must not
+        # flow into step 1, but step 0 chains through step 1 (same episode).
+        assert out[sb.ADVANTAGES][2, 0] == pytest.approx(0.5)  # delta2 only
+        assert out[sb.ADVANTAGES][1, 0] == pytest.approx(1.0)  # chain cut
+        assert out[sb.ADVANTAGES][0, 0] == pytest.approx(2.0)  # delta0+gae1
+
+
+class TestReplay:
+    def test_ring_buffer_wraps(self):
+        buf = ReplayBuffer(capacity=10, seed=0)
+        for i in range(4):
+            buf.add(SampleBatch({
+                "x": np.full(4, i, np.float32),
+            }))
+        assert len(buf) == 10
+        s = buf.sample(32)
+        assert s["x"].shape == (32,)
+        assert set(np.unique(s["x"])).issubset({1.0, 2.0, 3.0})  # 0s evicted
+
+    def test_prioritized_sampling_prefers_high_td(self):
+        buf = PrioritizedReplayBuffer(capacity=100, alpha=1.0, seed=0)
+        buf.add(SampleBatch({"x": np.arange(100, dtype=np.float32)}))
+        # Give item 7 an enormous priority.
+        buf.update_priorities(np.array([7]), np.array([1000.0]))
+        s = buf.sample(500)
+        frac = float(np.mean(s["x"] == 7.0))
+        assert frac > 0.5, frac
+        assert "weights" in s and s["weights"].max() <= 1.0
+
+
+class TestPPO:
+    def test_cartpole_learning(self):
+        cfg = (PPOConfig()
+               .environment("CartPole-v1", seed=0)
+               .rollouts(num_rollout_workers=0, num_envs_per_worker=8,
+                         rollout_fragment_length=128)
+               .training(lr=3e-4, num_sgd_iter=10, sgd_minibatch_size=256,
+                         entropy_coeff=0.01))
+        algo = cfg.build()
+        first = None
+        result = None
+        for i in range(25):
+            result = algo.train()
+            if first is None and result["episode_return_mean"] is not None:
+                first = result["episode_return_mean"]
+        assert result["episode_return_mean"] is not None
+        # CartPole starts ~20 with a random policy; PPO should be well on
+        # its way to the 500 cap within ~25 iters of 1024 steps.
+        assert result["episode_return_mean"] > 120, (
+            first, result["episode_return_mean"])
+        assert result["timesteps_total"] == 25 * 8 * 128
+
+    def test_pendulum_continuous_runs(self):
+        cfg = (PPOConfig()
+               .environment("Pendulum-v1", seed=0)
+               .rollouts(num_envs_per_worker=4, rollout_fragment_length=64)
+               .training(num_sgd_iter=2, sgd_minibatch_size=64))
+        algo = cfg.build()
+        r = algo.train()
+        assert np.isfinite(r["total_loss"])
+
+    def test_checkpoint_roundtrip(self):
+        cfg = (PPOConfig().environment("CartPole-v1")
+               .rollouts(num_envs_per_worker=2, rollout_fragment_length=32)
+               .training(num_sgd_iter=1, sgd_minibatch_size=32))
+        algo = cfg.build()
+        algo.train()
+        ckpt = algo.save_checkpoint()
+        algo2 = cfg.build()
+        algo2.load_checkpoint(ckpt)
+        import jax
+
+        w1, w2 = algo.get_weights(), algo2.get_weights()
+        for a, b in zip(jax.tree.leaves(w1), jax.tree.leaves(w2)):
+            np.testing.assert_array_equal(a, b)
+        assert algo2.iteration == 1
+
+
+class TestDQN:
+    def test_cartpole_learning(self):
+        cfg = (DQNConfig()
+               .environment("CartPole-v1", seed=0)
+               .rollouts(num_envs_per_worker=8)
+               .training(lr=1e-3, train_batch_size=512, learning_starts=1000,
+                         epsilon_timesteps=8000, target_update_freq=1000,
+                         sgd_rounds_per_step=8, prioritized_replay=True))
+        algo = cfg.build()
+        result = None
+        for _ in range(35):
+            result = algo.train()
+        assert result["loss"] is not None and np.isfinite(result["loss"])
+        # Windowed mean includes early exploration episodes; random play
+        # scores ~20, trained play caps at 500.
+        assert result["episode_return_mean"] > 45, result
