@@ -241,6 +241,13 @@ class RemoteFunction:
         )
         return refs[0] if o.get("num_returns", 1) == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of submitting (ref: dag/dag_node.py);
+        execute with `.execute()` or durably via ray_tpu.workflow."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             "Remote function cannot be called directly; use .remote()"
