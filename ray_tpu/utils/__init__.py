@@ -1,1 +1,10 @@
+"""Utility layer: collectives, actor pool, queue, multiprocessing shim.
 
+Parity: `/root/reference/python/ray/util/` (§2.3 "util misc" in SURVEY.md).
+"""
+
+from ray_tpu.utils.actor_pool import ActorPool
+from ray_tpu.utils.check_serialize import inspect_serializability
+from ray_tpu.utils.queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Queue", "Empty", "Full", "inspect_serializability"]

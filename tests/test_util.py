@@ -1,0 +1,167 @@
+"""Util layer: collectives, ActorPool, Queue, multiprocessing Pool,
+check_serialize."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.utils import ActorPool, Empty, Full, Queue, inspect_serializability
+from ray_tpu.utils import collective as col
+from ray_tpu.utils.multiprocessing import Pool
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestCollective:
+    def test_allreduce_allgather_across_tasks(self, cluster):
+        @ray_tpu.remote
+        def worker(rank, world):
+            from ray_tpu.utils import collective as col
+
+            col.init_collective_group(world, rank, group_name="g1")
+            s = col.allreduce(np.full(4, rank + 1.0), group_name="g1")
+            g = col.allgather(np.array([rank]), group_name="g1")
+            col.barrier(group_name="g1")
+            return s, [int(x) for x in g]
+
+        out = ray_tpu.get([worker.remote(r, 3) for r in range(3)])
+        for s, g in out:
+            np.testing.assert_array_equal(s, np.full(4, 6.0))  # 1+2+3
+            assert g == [0, 1, 2]
+
+    def test_reducescatter_broadcast_sendrecv(self, cluster):
+        @ray_tpu.remote
+        def worker(rank, world):
+            from ray_tpu.utils import collective as col
+
+            col.init_collective_group(world, rank, group_name="g2")
+            rs = col.reducescatter(np.arange(4, dtype=np.float64),
+                                   group_name="g2")
+            bc = col.broadcast(
+                np.array([42.0]) if rank == 0 else None,
+                src_rank=0, group_name="g2")
+            if rank == 0:
+                col.send(np.array([7.0]), dst_rank=1, group_name="g2")
+                p2p = None
+            elif rank == 1:
+                p2p = col.recv(src_rank=0, group_name="g2")
+            else:
+                p2p = None
+            return rs, float(bc[0]), p2p
+
+        out = ray_tpu.get([worker.remote(r, 2) for r in range(2)])
+        # reduce: [0,2,4,6]; rank0 slice [0,2], rank1 [4,6]
+        np.testing.assert_array_equal(out[0][0], [0.0, 2.0])
+        np.testing.assert_array_equal(out[1][0], [4.0, 6.0])
+        assert out[0][1] == out[1][1] == 42.0
+        np.testing.assert_array_equal(out[1][2], [7.0])
+
+
+class TestActorPool:
+    def test_map_ordered_and_unordered(self, cluster):
+        class Doubler:
+            def double(self, x):
+                return 2 * x
+
+        cls = ray_tpu.remote(Doubler)
+        pool = ActorPool([cls.remote() for _ in range(2)])
+        assert list(pool.map(lambda a, v: a.double.remote(v), range(6))) == [
+            0, 2, 4, 6, 8, 10]
+        out = sorted(pool.map_unordered(
+            lambda a, v: a.double.remote(v), range(6)))
+        assert out == [0, 2, 4, 6, 8, 10]
+
+    def test_submit_more_than_actors_queues(self, cluster):
+        class Id:
+            def f(self, x):
+                return x
+
+        cls = ray_tpu.remote(Id)
+        pool = ActorPool([cls.remote()])
+        for i in range(5):
+            pool.submit(lambda a, v: a.f.remote(v), i)
+        assert [pool.get_next() for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert not pool.has_next()
+
+
+class TestQueue:
+    def test_fifo_and_nowait(self, cluster):
+        q = Queue()
+        for i in range(3):
+            q.put(i)
+        assert q.qsize() == 3
+        assert [q.get() for _ in range(3)] == [0, 1, 2]
+        with pytest.raises(Empty):
+            q.get_nowait()
+        q.shutdown()
+
+    def test_maxsize_blocks_and_timeout(self, cluster):
+        q = Queue(maxsize=2)
+        q.put(1)
+        q.put(2)
+        with pytest.raises(Full):
+            q.put(3, timeout=0.2)
+        # A consumer unblocks the producer.
+        t = threading.Thread(target=lambda: q.put(3, timeout=10))
+        t.start()
+        assert q.get() == 1
+        t.join(10)
+        assert not t.is_alive()
+        assert sorted([q.get(), q.get()]) == [2, 3]
+        q.shutdown()
+
+    def test_cross_task_queue(self, cluster):
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(q, n):
+            for i in range(n):
+                q.put(i * i)
+            return True
+
+        ref = producer.remote(q, 4)
+        got = sorted(q.get(timeout=30) for _ in range(4))
+        assert got == [0, 1, 4, 9]
+        assert ray_tpu.get(ref)
+        q.shutdown()
+
+
+class TestMultiprocessingPool:
+    def test_map_and_starmap(self, cluster):
+        with Pool(processes=2) as pool:
+            assert pool.map(lambda x: x * x, range(8)) == [
+                0, 1, 4, 9, 16, 25, 36, 49]
+            assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+
+    def test_apply_and_imap(self, cluster):
+        pool = Pool(processes=2)
+        assert pool.apply(lambda a, b=0: a + b, (5,), {"b": 3}) == 8
+        assert list(pool.imap(lambda x: -x, range(4))) == [0, -1, -2, -3]
+        assert sorted(pool.imap_unordered(lambda x: -x, range(4))) == [
+            -3, -2, -1, 0]
+        pool.close()
+        pool.join()
+
+
+class TestCheckSerialize:
+    def test_ok_object(self):
+        ok, failures = inspect_serializability(lambda x: x + 1)
+        assert ok and not failures
+
+    def test_localizes_bad_closure(self):
+        lock = threading.Lock()
+
+        def f(x):
+            with lock:
+                return x
+
+        ok, failures = inspect_serializability(f)
+        assert not ok
+        assert any(fail.name == "lock" for fail in failures), failures
