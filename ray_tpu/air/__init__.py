@@ -1,0 +1,25 @@
+"""AIR: the shared glue layer across Train/Tune/Serve/Data.
+
+Parity: `/root/reference/python/ray/air/` — the canonical `Checkpoint`
+artifact (`air/checkpoint.py:61`), run/scaling/failure/checkpoint configs
+(`air/config.py`), the `session` reporting API (`air/session.py`), and
+`BatchPredictor` (`train/batch_predictor.py`). The implementations live in
+ray_tpu.train (one source of truth); this package is the stable AIR-named
+surface plus batch prediction over Data.
+"""
+
+from ray_tpu.air.batch_predictor import BatchPredictor, Predictor
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train import session
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "Result", "RunConfig",
+    "ScalingConfig", "session", "BatchPredictor", "Predictor",
+]
