@@ -9,6 +9,7 @@ actor compute the model loads (and compiles) once per actor, not per block.
 
 from __future__ import annotations
 
+import uuid
 from typing import Any, Callable, Type
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -23,6 +24,13 @@ class Predictor:
 
     def predict_batch(self, batch: Any) -> Any:
         raise NotImplementedError
+
+
+# Per-process predictor cache. The map closure is re-deserialized for every
+# block task, so closure state would rebuild the model per block; a stable
+# string key captured in the closure survives re-pickling and lands here,
+# giving one model load + jit compile per worker process.
+_PREDICTOR_CACHE: dict = {}
 
 
 class BatchPredictor:
@@ -44,15 +52,15 @@ class BatchPredictor:
         ckpt = self.checkpoint
         predictor_cls = self.predictor_cls
         kwargs = self.predictor_kwargs
-        state: dict[str, Predictor] = {}
+        cache_key = uuid.uuid4().hex  # stable across closure re-pickling
 
         def infer(batch):
-            # One predictor per executing worker process: model load + jit
-            # compile amortize across all its blocks.
-            p = state.get("p")
+            from ray_tpu.air.batch_predictor import _PREDICTOR_CACHE
+
+            p = _PREDICTOR_CACHE.get(cache_key)
             if p is None:
                 p = predictor_cls.from_checkpoint(ckpt, **kwargs)
-                state["p"] = p
+                _PREDICTOR_CACHE[cache_key] = p
             return p.predict_batch(batch)
 
         return dataset.map_batches(

@@ -1,0 +1,167 @@
+"""Autoregressive decoding with a slotted KV cache.
+
+The reference serves LLMs by delegating to torch models behind Serve
+replicas; the TPU-native equivalent is an explicit decode path designed for
+XLA: a fixed-shape KV cache of B slots × T_max positions lives in HBM,
+`prefill` writes one request's prompt into a slot (bucketed prompt lengths
+bound compilation count), and `decode_step` advances ALL active slots one
+token in a single fused program — the continuous-batching engine
+(ray_tpu.serve.llm) admits/retires requests between steps without ever
+changing tensor shapes.
+
+Works with ray_tpu.models.gpt params (scanned layer layout [L, ...]).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.gpt import _BLOCK_KEYS, GPTConfig, _layer_norm
+
+
+def init_kv_cache(cfg: GPTConfig, n_slots: int, max_len: int):
+    shape = (cfg.n_layers, n_slots, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _rotary_pos(x: jax.Array, rotary_dim: int, pos: jax.Array) -> jax.Array:
+    """Rotary with explicit per-row positions. x: [B, S, H, K]; pos: [B, S]."""
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, rotary_dim, 2) / rotary_dim))
+    ang = pos[..., None] * inv_freq  # [B, S, R/2]
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)  # [B, S, 1, R/2]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    x1, x2 = rot[..., 0::2], rot[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    rot = jnp.stack([out1, out2], axis=-1).reshape(rot.shape)
+    return jnp.concatenate([rot, rest], axis=-1)
+
+
+def _qkv(h, layer, cfg):
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    return q, k, v
+
+
+def _mlp(x, layer, cfg):
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    up = jax.nn.gelu(
+        jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+        + layer["b_up"].astype(cfg.dtype))
+    return x + (jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(cfg.dtype))
+                + layer["b_down"].astype(cfg.dtype))
+
+
+def _head(params, cfg, x):
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    head = params["lm_head"] if not cfg.tie_embeddings else params["wte"].T
+    return jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def prefill(cfg: GPTConfig, params, tokens, cache, slot, length):
+    """Write one prompt into cache slot; return last-token logits.
+
+    tokens: [1, S_bucket] (padded); slot: scalar int; length: scalar int
+    (true prompt length ≤ S_bucket). Compiles once per bucket size.
+    """
+    S = tokens.shape[1]
+    x = params["wte"].astype(cfg.dtype)[tokens]  # [1, S, D]
+    pos = jnp.arange(S)[None, :]  # [1, S]
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def body(x, inputs):
+        layer, k_cache_l, v_cache_l = inputs
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        q, k, v = _qkv(h, layer, cfg)
+        q = _rotary_pos(q, cfg.rotary_dim, pos)
+        k = _rotary_pos(k, cfg.rotary_dim, pos)
+        logits = jnp.einsum("bshk,bthk->bhst", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        causal = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        logits = jnp.where(causal[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn,
+                           layer["wo"].astype(cfg.dtype))
+        x = _mlp(x, layer, cfg)
+        # Write this layer's prompt K/V into the slot (padded tail included;
+        # masked out at decode time by the length-bounded attention mask).
+        k_cache_l = jax.lax.dynamic_update_slice(
+            k_cache_l, k.astype(cfg.dtype), (slot, 0, 0, 0))
+        v_cache_l = jax.lax.dynamic_update_slice(
+            v_cache_l, v.astype(cfg.dtype), (slot, 0, 0, 0))
+        return x, (k_cache_l, v_cache_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (stacked, cache["k"], cache["v"]))
+    logits = _head(params, cfg, x)  # [1, S, V]
+    last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, keepdims=False)
+    return last, {"k": new_k, "v": new_v}
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def decode_step(cfg: GPTConfig, params, tokens, cache, positions):
+    """One token for every slot. tokens: [B] int32 (the slot's current
+    token); positions: [B] (where that token sits). Inactive slots simply
+    produce garbage logits the engine ignores — shapes never change.
+
+    → (logits [B, V] fp32, updated cache).
+    """
+    B = tokens.shape[0]
+    T = cache["k"].shape[2]
+    x = params["wte"].astype(cfg.dtype)[tokens][:, None, :]  # [B, 1, D]
+    pos = positions[:, None]  # [B, 1]
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    batch_idx = jnp.arange(B)
+
+    def body(x, inputs):
+        layer, k_cache_l, v_cache_l = inputs
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        q, k, v = _qkv(h, layer, cfg)
+        q = _rotary_pos(q, cfg.rotary_dim, pos)
+        k = _rotary_pos(k, cfg.rotary_dim, pos)
+        # Insert this token's K/V at (slot b, positions[b]).
+        k_cache_l = k_cache_l.at[batch_idx, positions].set(
+            k[:, 0].astype(cfg.dtype))
+        v_cache_l = v_cache_l.at[batch_idx, positions].set(
+            v[:, 0].astype(cfg.dtype))
+        logits = jnp.einsum("bhk,bthk->bht", q[:, 0], k_cache_l,
+                            preferred_element_type=jnp.float32) * scale
+        mask = jnp.arange(T)[None, :] <= positions[:, None]  # [B, T]
+        logits = jnp.where(mask[:, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bht,bthk->bhk", probs, v_cache_l)
+        x = x + jnp.einsum("bhk,hkd->bd", attn,
+                           layer["wo"].astype(cfg.dtype))[:, None, :]
+        x = _mlp(x, layer, cfg)
+        return x, (k_cache_l, v_cache_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (stacked, cache["k"], cache["v"]))
+    logits = _head(params, cfg, x)[:, 0]  # [B, V]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def sample_token(logits, *, temperature: float = 0.0, top_k: int = 0,
+                 key=None):
+    """Greedy (temperature=0) or temperature/top-k sampling. logits: [V] or
+    [B, V] fp32 numpy/jax."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[..., -top_k][..., None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    assert key is not None, "sampling needs a PRNG key"
+    return jax.random.categorical(key, scaled, axis=-1)

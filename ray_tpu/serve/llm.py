@@ -1,0 +1,304 @@
+"""Continuous-batching LLM engine + Serve deployment.
+
+The TPU-native answer to LLM serving (BASELINE config 5: continuous-batched
+text generation). The reference batches requests per replica with
+`@serve.batch` (`/root/reference/python/ray/serve/batching.py`) — static
+batches that stall on the longest member. Here decode is *continuously*
+batched: a fixed pool of B cache slots advances one fused `decode_step`
+per iteration; requests join mid-flight via a bucketed `prefill` into a
+free slot and retire independently, so shapes are static (XLA-friendly)
+while occupancy tracks load.
+
+Design notes:
+- Prompt lengths round up to power-of-two buckets → one prefill
+  compilation per bucket, not per length.
+- The engine thread owns the cache; submit()/result flow through plain
+  thread-safe queues, so the Serve replica's asyncio loop never blocks on
+  device work.
+- TTFT = submit → first token (prefill latency + queue wait); recorded
+  per request for the Serve autoscaler and benchmarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class GenRequest:
+    request_id: str
+    prompt_ids: list[int]
+    max_tokens: int
+    temperature: float
+    eos_id: int | None
+    submitted_at: float
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    out_ids: list[int] = dataclasses.field(default_factory=list)
+    stream: "queue.Queue | None" = None
+    done: "threading.Event" = dataclasses.field(
+        default_factory=threading.Event)
+    error: str | None = None
+
+
+class LLMEngine:
+    """Slot-based continuous batching over ray_tpu.models.decode."""
+
+    def __init__(self, cfg, params=None, *, n_slots: int = 8,
+                 max_len: int = 2048, seed: int = 0,
+                 prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512)):
+        import jax
+
+        from ray_tpu.models import gpt
+        from ray_tpu.models.decode import init_kv_cache
+
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.buckets = tuple(sorted(prefill_buckets))
+        self.params = params if params is not None else gpt.init_params(
+            cfg, jax.random.key(seed))
+        self.cache = init_kv_cache(cfg, n_slots, max_len)
+        self.tokens = np.zeros(n_slots, np.int32)
+        self.positions = np.zeros(n_slots, np.int32)
+        self.slot_req: list[GenRequest | None] = [None] * n_slots
+        self.pending: "queue.Queue[GenRequest]" = queue.Queue()
+        self._rng_key = jax.random.key(seed)
+        self._shutdown = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self.stats = {"requests": 0, "tokens_generated": 0,
+                      "ttft_sum": 0.0, "completed": 0}
+
+    # ------------------------------------------------------------- API
+
+    def submit(self, prompt_ids: list[int], *, max_tokens: int = 64,
+               temperature: float = 0.0, eos_id: int | None = None,
+               stream: bool = False) -> GenRequest:
+        if len(prompt_ids) >= min(self.max_len, self.buckets[-1]):
+            raise ValueError(
+                f"prompt too long: {len(prompt_ids)} ≥ "
+                f"{min(self.max_len, self.buckets[-1])}")
+        req = GenRequest(
+            request_id=uuid.uuid4().hex[:12],
+            prompt_ids=list(prompt_ids),
+            max_tokens=max_tokens,
+            temperature=temperature,
+            eos_id=eos_id,
+            submitted_at=time.perf_counter(),
+            stream=queue.Queue() if stream else None,
+        )
+        self.stats["requests"] += 1
+        self.pending.put(req)
+        return req
+
+    def generate(self, prompt_ids: list[int], **kw) -> list[int]:
+        """Blocking convenience wrapper."""
+        req = self.submit(prompt_ids, **kw)
+        req.done.wait()
+        if req.error:
+            raise RuntimeError(req.error)
+        return req.out_ids
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="llm-engine")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def metrics(self) -> dict:
+        with self._lock:
+            active = sum(r is not None for r in self.slot_req)
+            m = dict(self.stats, active_slots=active,
+                     queued=self.pending.qsize(), n_slots=self.n_slots)
+        if m["completed"]:
+            m["ttft_mean_s"] = m["ttft_sum"] / m["completed"]
+        return m
+
+    # ------------------------------------------------------------- engine
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no bucket for prompt length {n}")
+
+    def _emit(self, req: GenRequest, token: int) -> bool:
+        """Append a token; → True if the request just finished."""
+        now = time.perf_counter()
+        if req.first_token_at is None:
+            req.first_token_at = now
+            self.stats["ttft_sum"] += now - req.submitted_at
+        req.out_ids.append(token)
+        if req.stream is not None:
+            req.stream.put(token)
+        self.stats["tokens_generated"] += 1
+        finished = (len(req.out_ids) >= req.max_tokens
+                    or (req.eos_id is not None and token == req.eos_id))
+        if finished:
+            req.finished_at = now
+            self.stats["completed"] += 1
+            if req.stream is not None:
+                req.stream.put(None)  # stream sentinel
+            req.done.set()
+        return finished
+
+    def _sample(self, logits_row, temperature: float) -> int:
+        import jax
+
+        from ray_tpu.models.decode import sample_token
+
+        if temperature == 0.0:
+            return int(np.argmax(logits_row))
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return int(sample_token(logits_row, temperature=temperature, key=sub))
+
+    def _admit(self) -> None:
+        """Prefill queued requests into free slots."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decode import prefill
+
+        for slot in range(self.n_slots):
+            if self.slot_req[slot] is not None:
+                continue
+            try:
+                req = self.pending.get_nowait()
+            except queue.Empty:
+                return
+            n = len(req.prompt_ids)
+            bucket = self._bucket(n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = req.prompt_ids
+            try:
+                last_logits, self.cache = prefill(
+                    self.cfg, self.params, jnp.asarray(padded), self.cache,
+                    jnp.int32(slot), jnp.int32(n))
+            except Exception as e:
+                req.error = f"prefill failed: {e!r}"
+                req.done.set()
+                continue
+            tok = self._sample(np.asarray(last_logits), req.temperature)
+            with self._lock:
+                self.slot_req[slot] = req
+            self.tokens[slot] = tok
+            self.positions[slot] = n
+            if self._emit(req, tok):
+                with self._lock:
+                    self.slot_req[slot] = None
+
+    def step(self) -> int:
+        """Admit + one decode step for all active slots. → #active."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decode import decode_step
+
+        self._admit()
+        active = [i for i in range(self.n_slots)
+                  if self.slot_req[i] is not None]
+        if not active:
+            return 0
+        logits, self.cache = decode_step(
+            self.cfg, self.params, jnp.asarray(self.tokens), self.cache,
+            jnp.asarray(self.positions))
+        logits = np.asarray(logits)
+        for slot in active:
+            req = self.slot_req[slot]
+            # Slot exhausted the cache: finish early rather than overflow.
+            if self.positions[slot] + 1 >= self.max_len:
+                req.error = None
+                req.finished_at = time.perf_counter()
+                self.stats["completed"] += 1
+                if req.stream is not None:
+                    req.stream.put(None)
+                req.done.set()
+                with self._lock:
+                    self.slot_req[slot] = None
+                continue
+            tok = self._sample(logits[slot], req.temperature)
+            self.tokens[slot] = tok
+            self.positions[slot] += 1
+            if self._emit(req, tok):
+                with self._lock:
+                    self.slot_req[slot] = None
+        return len(active)
+
+    def _loop(self) -> None:
+        while not self._shutdown.is_set():
+            n = self.step()
+            if n == 0 and self.pending.empty():
+                # Idle: block briefly instead of spinning.
+                time.sleep(0.002)
+
+
+class LLMDeployment:
+    """Serve deployment class wrapping one engine per replica.
+
+    serve.run(serve.deployment(LLMDeployment).options(...).bind(cfg_name))
+    Each replica owns its model + cache; the Serve router load-balances
+    requests across replicas, and the engine continuously batches within
+    the replica.
+    """
+
+    def __init__(self, model: str = "tiny", *, n_slots: int = 8,
+                 max_len: int = 1024, params_checkpoint: str | None = None,
+                 engine_kwargs: dict | None = None,
+                 jax_platform: str | None = None):
+        if jax_platform is not None:
+            # Must run before this replica process's JAX backend initializes
+            # (tests pin replicas to host CPU; production leaves the TPU).
+            import jax
+
+            jax.config.update("jax_platforms", jax_platform)
+        from ray_tpu.models import gpt
+
+        cfg_factory = getattr(gpt.GPTConfig, model)
+        cfg = cfg_factory()
+        params = None
+        if params_checkpoint:
+            from ray_tpu.train.checkpoint import Checkpoint
+
+            ck = Checkpoint.from_directory(params_checkpoint).to_dict()
+            params = ck["params"]
+        self.engine = LLMEngine(cfg, params, n_slots=n_slots,
+                                max_len=max_len, **(engine_kwargs or {}))
+        self.engine.start()
+
+    def generate(self, prompt_ids: list[int], max_tokens: int = 64,
+                 temperature: float = 0.0, eos_id: int | None = None) -> dict:
+        req = self.engine.submit(
+            prompt_ids, max_tokens=max_tokens, temperature=temperature,
+            eos_id=eos_id)
+        req.done.wait()
+        if req.error:
+            raise RuntimeError(req.error)
+        return {
+            "request_id": req.request_id,
+            "output_ids": req.out_ids,
+            "ttft_s": req.first_token_at - req.submitted_at,
+            "total_s": req.finished_at - req.submitted_at,
+        }
+
+    def metrics(self) -> dict:
+        return self.engine.metrics()
+
+    def __call__(self, request: dict) -> dict:
+        return self.generate(
+            request["prompt_ids"],
+            max_tokens=request.get("max_tokens", 64),
+            temperature=request.get("temperature", 0.0),
+            eos_id=request.get("eos_id"),
+        )
