@@ -111,7 +111,8 @@ def init(
 
     - address=None: start a single-node local cluster (GCS + raylet
       subprocesses), like the reference's `ray.init()` auto-start
-      (`_private/worker.py:1031`).
+      (`_private/worker.py:1031`) — unless RAY_TPU_ADDRESS is set (job
+      drivers, `ray job submit` children), which attaches instead.
     - address="host:port": attach to an existing GCS.
     """
     global _client, _node
@@ -120,6 +121,8 @@ def init(
             if ignore_reinit_error:
                 return _client
             raise RuntimeError("ray_tpu already initialized")
+        if address is None:
+            address = os.environ.get("RAY_TPU_ADDRESS")
         from ray_tpu.core.client import CoreClient
 
         config = Config.from_env().override(_system_config)
@@ -145,6 +148,9 @@ def init(
                 raylet_addr = (rh, int(rp))
             else:
                 raylet_addr = _pick_raylet(gcs_addr, config)
+            # Attached clients also need a clean close at exit (cancels the
+            # event-loop thread's connection tasks).
+            atexit.register(shutdown)
         _client = CoreClient(gcs_addr, raylet_addr, config)
         return _client
 

@@ -144,6 +144,13 @@ class CoreClient:
                     await c.close()
                 except Exception:
                     pass
+            # Retire cancelled read-loop tasks before the loop stops, else
+            # interpreter exit logs "Task was destroyed but it is pending".
+            tasks = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
 
         try:
             self._run(_close_all(), timeout=3)

@@ -1,0 +1,119 @@
+"""Dashboard: HTTP/JSON observability + job REST endpoints.
+
+Parity: `/root/reference/dashboard/` head (state + job modules). The React
+UI is out of scope; the API surface the reference's UI and `ray job` CLI
+consume is served as JSON from a stdlib threaded HTTP server running inside
+any client process (typically the head's CLI `start --head`):
+
+  GET  /api/cluster_status      summary (nodes, resources, actors)
+  GET  /api/nodes               node table
+  GET  /api/actors              actor table
+  GET  /api/memory              per-node object-store stats
+  GET  /api/jobs/               job list
+  POST /api/jobs/               {entrypoint, ...} → {job_id}
+  GET  /api/jobs/<id>           job info
+  GET  /api/jobs/<id>/logs      {logs}
+  POST /api/jobs/<id>/stop      {stopped}
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.job_submission import get_job_manager
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _json(self, obj: Any, code: int = 200) -> None:
+        body = json.dumps(obj, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(n).decode()) if n else {}
+
+    def do_GET(self):
+        try:
+            if self.path == "/api/cluster_status":
+                return self._json(state.cluster_status())
+            if self.path == "/api/nodes":
+                return self._json(state.list_nodes())
+            if self.path == "/api/actors":
+                return self._json(state.list_actors())
+            if self.path == "/api/memory":
+                return self._json(state.object_store_stats())
+            if self.path in ("/api/jobs", "/api/jobs/"):
+                return self._json(ray_tpu.get(
+                    self.server.jobs.list.remote(), timeout=30))
+            m = re.fullmatch(r"/api/jobs/([^/]+)/logs", self.path)
+            if m:
+                logs = ray_tpu.get(
+                    self.server.jobs.logs.remote(m.group(1)), timeout=30)
+                return self._json({"logs": logs})
+            m = re.fullmatch(r"/api/jobs/([^/]+)", self.path)
+            if m:
+                info = ray_tpu.get(
+                    self.server.jobs.status.remote(m.group(1)), timeout=30)
+                if info is None:
+                    return self._json({"error": "not found"}, 404)
+                return self._json(info)
+            self._json({"error": "unknown endpoint"}, 404)
+        except Exception as e:
+            self._json({"error": repr(e)}, 500)
+
+    def do_POST(self):
+        try:
+            if self.path in ("/api/jobs", "/api/jobs/"):
+                b = self._body()
+                job_id = ray_tpu.get(self.server.jobs.submit.remote(
+                    b["entrypoint"], job_id=b.get("job_id"),
+                    env=b.get("env"), metadata=b.get("metadata")),
+                    timeout=60)
+                return self._json({"job_id": job_id})
+            m = re.fullmatch(r"/api/jobs/([^/]+)/stop", self.path)
+            if m:
+                stopped = ray_tpu.get(
+                    self.server.jobs.stop.remote(m.group(1)), timeout=30)
+                return self._json({"stopped": stopped})
+            self._json({"error": "unknown endpoint"}, 404)
+        except Exception as e:
+            self._json({"error": repr(e)}, 500)
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.server = ThreadingHTTPServer((host, port), _Handler)
+        self.server.jobs = get_job_manager()
+        self.host, self.port = self.server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True, name="dashboard")
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "Dashboard":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    """Requires an initialized ray_tpu client in this process."""
+    return Dashboard(host, port).start()

@@ -1,0 +1,244 @@
+"""CLI: `python -m ray_tpu <command>`.
+
+Parity: `/root/reference/python/ray/scripts/scripts.py:2542-2586` —
+start/stop/status/list/memory/submit/job. argparse instead of click (no
+extra deps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+STATE_DIR = os.path.expanduser("~/.ray_tpu")
+HEAD_FILE = os.path.join(STATE_DIR, "head.json")
+
+
+def _save_head(info: dict) -> None:
+    os.makedirs(STATE_DIR, exist_ok=True)
+    with open(HEAD_FILE, "w") as f:
+        json.dump(info, f)
+
+
+def _load_head() -> dict | None:
+    try:
+        with open(HEAD_FILE) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _resolve_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    head = _load_head()
+    if head:
+        return head["gcs_address"]
+    sys.exit("no cluster address: pass --address, set RAY_TPU_ADDRESS, "
+             "or run `start --head` on this machine first")
+
+
+def cmd_start(args) -> None:
+    from ray_tpu.core.config import Config
+    from ray_tpu.core.node import Node
+
+    config = Config.from_env()
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    resources.setdefault("CPU", os.cpu_count() or 1)
+
+    if args.head:
+        node = Node(config, head=True, resources=resources)
+        node.start()
+        gcs = f"{node.gcs_address[0]}:{node.gcs_address[1]}"
+        _save_head({
+            "gcs_address": gcs,
+            "session_dir": node.session_dir,
+            "pid": os.getpid(),
+        })
+        print(f"head started; GCS at {gcs}")
+        print(f"attach drivers with ray_tpu.init(address={gcs!r}) or "
+              f"RAY_TPU_ADDRESS={gcs}")
+        dash = None
+        if not args.no_dashboard:
+            import ray_tpu
+
+            ray_tpu.init(address=gcs)
+            from ray_tpu.dashboard import start_dashboard
+
+            dash = start_dashboard(port=args.dashboard_port)
+            print(f"dashboard at {dash.url}")
+    else:
+        addr = _resolve_address(args)
+        host, port = addr.rsplit(":", 1)
+        node = Node(config, head=False, resources=resources,
+                    gcs_address=(host, int(port)))
+        node.start()
+        print(f"node started; raylet at {node.raylet_address}, "
+              f"joined GCS {addr}")
+
+    if args.block or args.head:
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+        try:
+            while not stop:
+                time.sleep(0.5)
+        finally:
+            node.stop()
+            if args.head:
+                try:
+                    os.unlink(HEAD_FILE)
+                except FileNotFoundError:
+                    pass
+
+
+def cmd_stop(args) -> None:
+    head = _load_head()
+    if head is None:
+        sys.exit("no local head recorded")
+    try:
+        os.kill(head["pid"], signal.SIGTERM)
+        print(f"sent SIGTERM to head pid {head['pid']}")
+    except ProcessLookupError:
+        print("head process already gone")
+        try:
+            os.unlink(HEAD_FILE)
+        except FileNotFoundError:
+            pass
+
+
+def _attach(args) -> None:
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+
+
+def cmd_status(args) -> None:
+    from ray_tpu import state
+
+    _attach(args)
+    s = state.cluster_status()
+    print(f"nodes: {s['nodes_alive']} alive, {s['nodes_dead']} dead")
+    print(f"actors: {s['actors_alive']} alive / {s['actors_total']} total")
+    print("resources:")
+    for k in sorted(s["resources_total"]):
+        avail = s["resources_available"].get(k, 0)
+        print(f"  {k}: {avail:g}/{s['resources_total'][k]:g} available")
+
+
+def cmd_list(args) -> None:
+    from ray_tpu import state
+
+    _attach(args)
+    if args.kind == "nodes":
+        rows = state.list_nodes()
+    elif args.kind == "actors":
+        rows = state.list_actors()
+    else:
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        rows = JobSubmissionClient().list_jobs()
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_memory(args) -> None:
+    from ray_tpu import state
+
+    _attach(args)
+    for row in state.object_store_stats():
+        print(f"node {row['node_id'][:12]}: {row['objects']} objects, "
+              f"{row['shm_bytes']}/{row['capacity']} bytes shm "
+              f"({row['spilled']} spilled, native={row['native_allocator']})")
+
+
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    if args.job_cmd == "submit":
+        _attach(args)
+        client = JobSubmissionClient()
+        import shlex
+
+        entry = args.entrypoint
+        if entry and entry[0] == "--":  # argparse.REMAINDER keeps the sep
+            entry = entry[1:]
+        job_id = client.submit_job(entrypoint=shlex.join(entry))
+        print(job_id)
+        if args.wait:
+            status = client.wait_until_finished(job_id, timeout=args.timeout)
+            print(client.get_job_logs(job_id), end="")
+            sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_cmd == "status":
+        _attach(args)
+        print(JobSubmissionClient().get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        _attach(args)
+        print(JobSubmissionClient().get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "stop":
+        _attach(args)
+        print(JobSubmissionClient().stop_job(args.job_id))
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS host:port (worker nodes)")
+    sp.add_argument("--num-cpus", type=int)
+    sp.add_argument("--resources", help='JSON, e.g. \'{"TPU": 4}\'')
+    sp.add_argument("--block", action="store_true")
+    sp.add_argument("--no-dashboard", action="store_true")
+    sp.add_argument("--dashboard-port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the locally started head")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster summary")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["nodes", "actors", "jobs"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("memory", help="object store stats per node")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    j = jsub.add_parser("status")
+    j.add_argument("job_id")
+    j.add_argument("--address")
+    j = jsub.add_parser("logs")
+    j.add_argument("job_id")
+    j.add_argument("--address")
+    j = jsub.add_parser("stop")
+    j.add_argument("job_id")
+    j.add_argument("--address")
+    sp.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""State API: introspect nodes, actors, and object stores.
+
+Parity: `/root/reference/python/ray/experimental/state/api.py` +
+`_private/state.py` (GlobalState over GlobalStateAccessor) — `ray list
+nodes/actors`, `ray memory`, cluster resource totals. Data comes straight
+from the GCS tables (cluster view, actor directory) and per-raylet store
+stats; no separate aggregator process is needed at this scale.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config
+
+
+def _gcs_address() -> tuple[str, int]:
+    from ray_tpu import api
+
+    client = api._ensure_client()
+    return client.gcs_address
+
+
+def _call_gcs(method: str, payload: dict | None = None) -> Any:
+    async def go():
+        cfg = Config.from_env()
+        conn = await rpc.connect(*_gcs_address(),
+                                 timeout=cfg.rpc_connect_timeout_s)
+        try:
+            return await conn.call(method, payload or {})
+        finally:
+            await conn.close()
+
+    return asyncio.run(go())
+
+
+def list_nodes() -> list[dict]:
+    """One row per node: id, address, aliveness, resources."""
+    view = _call_gcs("get_cluster_view")
+    out = []
+    for node_id, info in view.items():
+        row = dict(info)
+        row["node_id"] = (node_id.hex() if isinstance(node_id, bytes)
+                          else str(node_id))
+        out.append(row)
+    return sorted(out, key=lambda r: r["node_id"])
+
+
+def list_actors(*, state: str | None = None) -> list[dict]:
+    """Actor directory rows (id, class, state, node, restarts)."""
+    rows = _call_gcs("list_actors")
+    out = []
+    for r in rows:
+        row = dict(r)
+        if isinstance(row.get("actor_id"), bytes):
+            row["actor_id"] = row["actor_id"].hex()
+        if state is None or row.get("state") == state:
+            out.append(row)
+    return out
+
+
+def object_store_stats() -> list[dict]:
+    """Per-node shared-memory store stats (ray memory equivalent)."""
+    nodes = list_nodes()
+    cfg = Config.from_env()
+
+    async def fetch(addr):
+        try:
+            conn = await rpc.connect(*addr, timeout=5.0)
+            try:
+                return await conn.call("store_stats", {})
+            finally:
+                await conn.close()
+        except Exception:
+            return None
+
+    async def go():
+        return await asyncio.gather(*[
+            fetch(tuple(n["address"])) for n in nodes if n.get("alive", True)
+        ])
+
+    stats = asyncio.run(go())
+    out = []
+    for n, s in zip([n for n in nodes if n.get("alive", True)], stats):
+        if s is not None:
+            out.append({"node_id": n["node_id"], **s})
+    return out
+
+
+def cluster_status() -> dict:
+    """Summary used by `status` CLI and the dashboard."""
+    nodes = list_nodes()
+    alive = [n for n in nodes if n.get("alive", True)]
+    total: dict[str, float] = {}
+    avail: dict[str, float] = {}
+    for n in alive:
+        for k, v in (n.get("resources_total") or n.get("resources") or {}).items():
+            total[k] = total.get(k, 0) + v
+        for k, v in (n.get("resources_available") or {}).items():
+            avail[k] = avail.get(k, 0) + v
+    actors = list_actors()
+    return {
+        "nodes_alive": len(alive),
+        "nodes_dead": len(nodes) - len(alive),
+        "resources_total": total,
+        "resources_available": avail,
+        "actors_alive": sum(1 for a in actors if a.get("state") == "ALIVE"),
+        "actors_total": len(actors),
+    }

@@ -1,0 +1,142 @@
+"""Ops layer: state API, job submission (+REST), dashboard, CLI.
+
+Mirrors `/root/reference/dashboard/modules/job/tests` + state API tests at
+small scale.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.job_submission import JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestStateApi:
+    def test_list_nodes(self, cluster):
+        nodes = state.list_nodes()
+        assert len(nodes) == 1
+        n = nodes[0]
+        assert n["alive"] and n["resources_total"]["CPU"] == 4
+
+    def test_list_actors_sees_new_actor(self, cluster):
+        @ray_tpu.remote
+        class Marker:
+            def ping(self):
+                return "pong"
+
+        a = Marker.options(name="state_marker").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+        actors = state.list_actors(state="ALIVE")
+        assert any(r.get("name") == "state_marker" for r in actors), actors
+        ray_tpu.kill(a)
+
+    def test_object_store_stats(self, cluster):
+        import numpy as np
+
+        ref = ray_tpu.put(np.zeros(100_000))
+        stats = state.object_store_stats()
+        assert stats and stats[0]["shm_bytes"] > 0
+        assert stats[0]["native_allocator"] is True
+        del ref
+
+    def test_cluster_status(self, cluster):
+        s = state.cluster_status()
+        assert s["nodes_alive"] == 1
+        assert s["resources_total"]["CPU"] == 4
+
+
+class TestJobs:
+    def test_submit_and_wait(self, cluster):
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"print('job ran ok')\"")
+        status = client.wait_until_finished(job_id, timeout=120)
+        assert status == "SUCCEEDED"
+        assert "job ran ok" in client.get_job_logs(job_id)
+
+    def test_failed_job_status(self, cluster):
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+        assert client.wait_until_finished(job_id, timeout=120) == "FAILED"
+        assert client.get_job_info(job_id)["return_code"] == 3
+
+    def test_job_driver_attaches_to_cluster(self, cluster):
+        """The entrypoint's ray_tpu.init() must attach to THIS cluster (via
+        RAY_TPU_ADDRESS), not boot a private one."""
+        client = JobSubmissionClient()
+        script = (
+            "import ray_tpu; ray_tpu.init(); "
+            "print('CPUS', float(ray_tpu.cluster_resources().get('CPU')))"
+        )
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"{script}\"")
+        assert client.wait_until_finished(job_id, timeout=180) == "SUCCEEDED"
+        assert "CPUS 4.0" in client.get_job_logs(job_id)
+
+    def test_stop_job(self, cluster):
+        client = JobSubmissionClient()
+        job_id = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+        time.sleep(1.0)
+        assert client.stop_job(job_id)
+        assert client.wait_until_finished(job_id, timeout=60) == "STOPPED"
+
+
+class TestDashboard:
+    def test_endpoints_and_rest_jobs(self, cluster):
+        from ray_tpu.dashboard import start_dashboard
+
+        dash = start_dashboard(port=0)
+        try:
+            def get(path):
+                with urllib.request.urlopen(dash.url + path, timeout=30) as r:
+                    return json.loads(r.read().decode())
+
+            s = get("/api/cluster_status")
+            assert s["nodes_alive"] == 1
+            assert len(get("/api/nodes")) == 1
+            assert isinstance(get("/api/actors"), list)
+            assert get("/api/memory")[0]["capacity"] > 0
+
+            # REST job submission through the JobSubmissionClient facade.
+            client = JobSubmissionClient(dash.url)
+            job_id = client.submit_job(
+                entrypoint=f"{sys.executable} -c \"print('rest job')\"")
+            assert client.wait_until_finished(job_id, timeout=120) == "SUCCEEDED"
+            assert "rest job" in client.get_job_logs(job_id)
+            assert any(j["job_id"] == job_id for j in client.list_jobs())
+        finally:
+            dash.stop()
+
+
+class TestCli:
+    def test_status_and_list_against_running_cluster(self, cluster):
+        from ray_tpu import api
+
+        gcs = api._ensure_client().gcs_address
+        addr = f"{gcs[0]}:{gcs[1]}"
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "status", "--address", addr],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "nodes: 1 alive" in out.stdout
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "list", "nodes",
+             "--address", addr],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout)[0]["alive"] is True
