@@ -38,6 +38,7 @@ class NodeInfo:
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
     load: int = 0                     # queued lease requests
+    pending_demand: list = field(default_factory=list)  # their resource shapes
 
 
 @dataclass
@@ -133,6 +134,7 @@ class GcsServer:
         info.last_heartbeat = time.monotonic()
         info.resources_available = p["resources_available"]
         info.load = p.get("load", 0)
+        info.pending_demand = p.get("pending_demand", [])
         info.alive = True
         return {"ok": True}
 
@@ -144,6 +146,7 @@ class GcsServer:
                 "resources_available": n.resources_available,
                 "alive": n.alive,
                 "load": n.load,
+                "pending_demand": n.pending_demand,
                 "labels": n.labels,
             }
             for nid, n in self.nodes.items()

@@ -57,11 +57,13 @@ class Node:
         resources: dict[str, float],
         gcs_address: tuple[str, int] | None = None,
         session_dir: str | None = None,
+        labels: dict[str, str] | None = None,
     ):
         self.config = config
         self.head = head
         self.resources = resources
         self.gcs_address = gcs_address
+        self.labels = labels or {}
         self.raylet_address: tuple[str, int] | None = None
         self.procs: list[subprocess.Popen] = []
         self.session_dir = session_dir or os.path.join(
@@ -86,6 +88,7 @@ class Node:
             [sys.executable, "-m", "ray_tpu.core.raylet",
              "--gcs", f"{self.gcs_address[0]}:{self.gcs_address[1]}",
              "--resources", json.dumps(self.resources),
+             "--labels", json.dumps(self.labels),
              "--config", self._config_path,
              "--session-dir", self.session_dir],
             os.path.join(logs, "raylet.log"),

@@ -171,6 +171,13 @@ class Raylet:
                     "node_id": self.node_id,
                     "resources_available": self.resources_available,
                     "load": len(self.lease_queue),
+                    # Resource shapes of queued leases — the autoscaler's
+                    # demand signal (ref: gcs_resource_manager.cc resource
+                    # load; resource_demand_scheduler.py consumes it).
+                    "pending_demand": [
+                        dict(req.resources) for req in
+                        list(self.lease_queue)[:100]
+                    ],
                 }, timeout=5.0)
                 if resp.get("reregister"):
                     await self.gcs.call("register_node", {
@@ -611,6 +618,7 @@ def main() -> None:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--resources", default="{}")
+    ap.add_argument("--labels", default="{}")
     ap.add_argument("--config", default=None)
     ap.add_argument("--session-dir", default=None)
     ap.add_argument("--ready-fd", type=int, default=None)
@@ -627,6 +635,7 @@ def main() -> None:
         raylet = Raylet(
             config, (ghost, int(gport)), resources,
             args.host, args.port, session_dir=args.session_dir,
+            labels=json.loads(args.labels),
         )
         host, port = await raylet.start()
         if args.ready_fd is not None:

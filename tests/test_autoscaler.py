@@ -1,0 +1,142 @@
+"""Autoscaler: bin-packing logic, reconcile loop, and a real end-to-end
+scale-up with subprocess nodes (fake-multi-node style)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    LocalSubprocessProvider,
+    MockProvider,
+    NodeType,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.autoscaler import get_nodes_to_launch
+
+CPU4 = NodeType("cpu4", {"CPU": 4.0}, max_workers=5)
+BIG = NodeType("big", {"CPU": 16.0}, max_workers=2)
+
+
+class TestBinPacking:
+    def test_demand_fits_existing_capacity(self):
+        plan = get_nodes_to_launch(
+            [{"CPU": 1.0}] * 3, [{"CPU": 4.0}], [CPU4], {})
+        assert plan == {}
+
+    def test_unmet_demand_launches_nodes(self):
+        plan = get_nodes_to_launch(
+            [{"CPU": 1.0}] * 10, [{"CPU": 1.0}], [CPU4], {})
+        # 1 fits existing; 9 need ceil(9/4) = 3 new cpu4 nodes.
+        assert plan == {"cpu4": 3}
+
+    def test_max_workers_bounds_launches(self):
+        plan = get_nodes_to_launch(
+            [{"CPU": 4.0}] * 10, [], [NodeType("cpu4", {"CPU": 4.0},
+                                               max_workers=2)], {})
+        assert plan == {"cpu4": 2}
+
+    def test_big_shape_picks_big_node(self):
+        plan = get_nodes_to_launch(
+            [{"CPU": 8.0}], [{"CPU": 4.0}], [CPU4, BIG], {})
+        assert plan == {"big": 1}
+
+    def test_infeasible_shape_ignored(self):
+        plan = get_nodes_to_launch(
+            [{"CPU": 64.0}], [], [CPU4, BIG], {})
+        assert plan == {}
+
+
+class TestReconcile:
+    def _view(self, nodes):
+        return {
+            f"n{i}".encode(): {
+                "alive": True,
+                "resources_total": n["total"],
+                "resources_available": n.get("avail", n["total"]),
+                "pending_demand": n.get("demand", []),
+                "labels": n.get("labels", {}),
+            }
+            for i, n in enumerate(nodes)
+        }
+
+    def test_scale_up_on_demand(self):
+        provider = MockProvider()
+        asc = StandardAutoscaler(provider, [CPU4], idle_timeout_s=0.0)
+        view = self._view([{
+            "total": {"CPU": 2.0}, "avail": {"CPU": 0.0},
+            "demand": [{"CPU": 1.0}] * 6,
+        }])
+        out = asc.update(view)
+        assert len(out["launched"]) == 2  # 6 CPU over 2 cpu4 nodes
+        assert provider.nodes
+
+    def test_min_workers_maintained(self):
+        provider = MockProvider()
+        asc = StandardAutoscaler(
+            provider, [NodeType("cpu4", {"CPU": 4.0}, min_workers=2)])
+        out = asc.update(self._view([]))
+        assert len(out["launched"]) == 2
+
+    def test_idle_scale_down_after_timeout(self):
+        provider = MockProvider()
+        asc = StandardAutoscaler(provider, [CPU4], idle_timeout_s=0.2)
+        nid = provider.create_node(CPU4)
+        view = self._view([{
+            "total": {"CPU": 4.0},
+            "labels": {"provider_node_id": nid},
+        }])
+        out1 = asc.update(view)
+        assert out1["terminated"] == []  # idle timer just started
+        time.sleep(0.25)
+        out2 = asc.update(view)
+        assert out2["terminated"] == [nid]
+
+    def test_busy_labeled_node_not_terminated(self):
+        provider = MockProvider()
+        asc = StandardAutoscaler(provider, [CPU4], idle_timeout_s=0.0)
+        nid = provider.create_node(CPU4)
+        view = self._view([{
+            "total": {"CPU": 4.0}, "avail": {"CPU": 1.0},
+            "labels": {"provider_node_id": nid},
+        }])
+        out = asc.update(view)
+        assert out["terminated"] == []
+
+
+class TestEndToEnd:
+    def test_autoscaled_node_runs_tasks(self):
+        """Demand on a saturated 1-CPU cluster triggers a real subprocess
+        node launch; queued tasks then run on it."""
+        ray_tpu.init(num_cpus=1)
+        try:
+            from ray_tpu import api
+
+            gcs = api._ensure_client().gcs_address
+            provider = LocalSubprocessProvider(gcs)
+            asc = StandardAutoscaler(
+                provider, [NodeType("cpu2", {"CPU": 2.0}, max_workers=2)],
+                gcs_address=gcs)
+
+            @ray_tpu.remote
+            def busy(sec):
+                import time as _t
+
+                _t.sleep(sec)
+                return 1
+
+            # Saturate the head CPU and queue more work.
+            refs = [busy.remote(8) ] + [busy.remote(0.1) for _ in range(6)]
+            deadline = time.monotonic() + 60
+            launched = []
+            while time.monotonic() < deadline and not launched:
+                time.sleep(1.0)
+                launched = asc.update()["launched"]
+            assert launched, "autoscaler never launched a node"
+            # Queued tasks complete well before the 8s head task would
+            # free capacity for them sequentially.
+            out = ray_tpu.get(refs[1:], timeout=60)
+            assert out == [1] * 6
+            provider.terminate_all()
+        finally:
+            ray_tpu.shutdown()
