@@ -1,6 +1,7 @@
 """Data: distributed datasets on the object store (Ray Data parity)."""
 
 from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.read_api import (
     from_arrow,
     from_items,
@@ -13,6 +14,6 @@ from ray_tpu.data.read_api import (
 )
 
 __all__ = [
-    "Dataset", "GroupedData", "from_arrow", "from_items", "from_numpy",
+    "Dataset", "DatasetPipeline", "GroupedData", "from_arrow", "from_items", "from_numpy",
     "from_pandas", "range", "read_csv", "read_json", "read_parquet",
 ]

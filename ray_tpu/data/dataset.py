@@ -258,6 +258,48 @@ class Dataset:
 
     # ------------------------------------------------------------ iteration
 
+    # ------------------------------------------------------------ io / export
+
+    def write_parquet(self, path: str) -> list:
+        """One parquet file per block under `path`
+        (ref: data/dataset.py write_parquet)."""
+        from ray_tpu.data import write_api
+
+        return write_api.write_blocks(
+            self._materialized_refs(), path, "parquet",
+            write_api._write_parquet_task)
+
+    def write_csv(self, path: str) -> list:
+        from ray_tpu.data import write_api
+
+        return write_api.write_blocks(
+            self._materialized_refs(), path, "csv",
+            write_api._write_csv_task)
+
+    def write_json(self, path: str) -> list:
+        from ray_tpu.data import write_api
+
+        return write_api.write_blocks(
+            self._materialized_refs(), path, "json",
+            write_api._write_json_task)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        rows = self.take_all()
+        return pd.DataFrame(rows)
+
+    def window(self, *, blocks_per_window: int = 1):
+        """Windowed streaming pipeline (ref: dataset_pipeline.py)."""
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(
+            self, blocks_per_window=blocks_per_window)
+
+    def repeat(self, times: int):
+        return self.window(blocks_per_window=max(1, self.num_blocks())
+                           ).repeat(times)
+
     def iter_rows(self) -> Iterator:
         for ref in self._materialized_refs():
             yield from B.to_rows(ray_tpu.get(ref, timeout=300))

@@ -1,0 +1,59 @@
+"""Dataset write APIs: one output file per block, written by tasks.
+
+Parity: `/root/reference/python/ray/data/dataset.py` write_parquet/
+write_csv/write_json over `data/datasource/file_based_datasource.py`.
+"""
+
+from __future__ import annotations
+
+import os
+
+import ray_tpu
+
+
+def _block_table(blk):
+    import pyarrow as pa
+
+    from ray_tpu.data import block as B
+
+    if isinstance(blk, pa.Table):
+        return blk
+    # Simple (list) blocks: wrap as a single "value" column.
+    return pa.table({"value": list(blk)})
+
+
+@ray_tpu.remote
+def _write_parquet_task(blk, path):
+    import pyarrow.parquet as pq
+
+    pq.write_table(_block_table(blk), path)
+    return path
+
+
+@ray_tpu.remote
+def _write_csv_task(blk, path):
+    import pyarrow.csv as pacsv
+
+    pacsv.write_csv(_block_table(blk), path)
+    return path
+
+
+@ray_tpu.remote
+def _write_json_task(blk, path):
+    import json
+
+    from ray_tpu.data import block as B
+
+    with open(path, "w") as f:
+        for row in B.to_rows(blk):
+            f.write(json.dumps(row, default=str) + "\n")
+    return path
+
+
+def write_blocks(refs: list, path: str, suffix: str, task) -> list[str]:
+    os.makedirs(path, exist_ok=True)
+    out_refs = [
+        task.remote(ref, os.path.join(path, f"part-{i:05d}.{suffix}"))
+        for i, ref in enumerate(refs)
+    ]
+    return ray_tpu.get(out_refs)
