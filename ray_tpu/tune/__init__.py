@@ -3,10 +3,15 @@
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    RandomSearcher,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -16,7 +21,9 @@ from ray_tpu.tune.search import (
 from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 
 __all__ = [
-    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+    "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+    "Searcher", "RandomSearcher", "TPESearcher",
     "BasicVariantGenerator", "choice", "grid_search", "loguniform",
     "randint", "uniform", "ResultGrid", "Trial", "TuneConfig", "Tuner",
 ]

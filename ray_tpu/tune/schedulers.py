@@ -125,3 +125,79 @@ class PopulationBasedTraining:
                 "config": new_cfg,
                 "from_trial": src,
             }
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' averages at the same step (ref: schedulers/
+    median_stopping_rule.py)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.sign = 1.0 if mode == "max" else -1.0
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial_id → list of signed metric values per step
+        self._history: dict[str, list[float]] = {}
+
+    def on_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if self.metric not in result:
+            return CONTINUE
+        h = self._history.setdefault(trial.trial_id, [])
+        h.append(self.sign * result[self.metric])
+        if t < self.grace_period:
+            return CONTINUE
+        step = len(h)
+        means = [
+            sum(other[:step]) / step
+            for tid, other in self._history.items()
+            if tid != trial.trial_id and len(other) >= step
+        ]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        means.sort()
+        median = means[len(means) // 2]
+        my_mean = sum(h) / step
+        return STOP if my_mean < median else CONTINUE
+
+
+class HyperBandScheduler:
+    """Synchronous-ish HyperBand bracket (ref: schedulers/hyperband.py),
+    adapted to the event-driven on_result seam: trials are assigned to the
+    bracket's rungs; at each rung boundary a trial stops unless it is in
+    the top 1/eta of finishers at that rung so far."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, eta: int = 3):
+        self.metric = metric
+        self.sign = 1.0 if mode == "max" else -1.0
+        self.time_attr = time_attr
+        self.rungs: list[int] = []
+        r = max_t
+        while r >= 1:
+            self.rungs.append(int(r))
+            r //= eta
+        self.rungs = sorted(set(self.rungs))  # ascending rung milestones
+        self.eta = eta
+        self.max_t = max_t
+        self._rung_scores: dict[int, list[float]] = {r: [] for r in self.rungs}
+
+    def on_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if self.metric not in result:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        if t not in self._rung_scores:
+            return CONTINUE
+        score = self.sign * result[self.metric]
+        scores = self._rung_scores[t]
+        scores.append(score)
+        k = max(1, len(scores) // self.eta)
+        cutoff = sorted(scores, reverse=True)[k - 1]
+        return CONTINUE if score >= cutoff else STOP

@@ -108,3 +108,150 @@ class BasicVariantGenerator:
                 cfg.update(dict(combo))
                 out.append(cfg)
         return out
+
+
+class Searcher:
+    """Sequential suggestion seam (ref: tune/search/searcher.py): the
+    Tuner asks for a config per new trial and reports observed results, so
+    model-based searchers can adapt. Subclass and implement suggest()."""
+
+    def __init__(self, param_space: dict, seed: int | None = None):
+        self.param_space = param_space
+        self.rng = random.Random(seed)
+
+    def suggest(self, trial_id: str) -> dict:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        pass
+
+    def _sample_space(self) -> dict:
+        cfg = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, GridSearch):
+                cfg[k] = self.rng.choice(v.values)
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+
+class RandomSearcher(Searcher):
+    def suggest(self, trial_id: str) -> dict:
+        return self._sample_space()
+
+
+class TPESearcher(Searcher):
+    """Tree-structured-Parzen-style searcher (the role HyperOpt plays for
+    the reference, without the dependency): after `n_initial` random
+    trials, candidates are drawn near configs in the top `gamma` quantile
+    and scored by a good/bad density ratio per dimension.
+
+    Continuous domains use Gaussian kernels around good observations;
+    choice/grid dims sample from the good histogram with smoothing.
+    """
+
+    def __init__(self, param_space: dict, metric: str, mode: str = "max",
+                 seed: int | None = None, n_initial: int = 5,
+                 gamma: float = 0.25, n_candidates: int = 24):
+        super().__init__(param_space, seed)
+        self.metric = metric
+        self.sign = 1.0 if mode == "max" else -1.0
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._observed: list[tuple[dict, float]] = []
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        if result and self.metric in result:
+            self._observed.append(
+                (dict(result["config"]) if "config" in result else {},
+                 self.sign * result[self.metric]))
+
+    def observe(self, config: dict, value: float) -> None:
+        self._observed.append((config, self.sign * value))
+
+    def _split(self):
+        obs = sorted(self._observed, key=lambda o: -o[1])
+        n_good = max(1, int(len(obs) * self.gamma))
+        return obs[:n_good], obs[n_good:]
+
+    def _kernel_sample(self, key: str, domain, good: list[dict]):
+        vals = [g[key] for g, _ in [(g, v) for g, v in good] if key in g]
+        if not vals:
+            return domain.sample(self.rng) if isinstance(domain, Domain) else domain
+        if isinstance(domain, (Uniform, LogUniform)):
+            import math
+
+            center = self.rng.choice(vals)
+            if isinstance(domain, LogUniform):
+                lo, hi = math.log(domain.low), math.log(domain.high)
+                c = math.log(center)
+                draw = self.rng.gauss(c, (hi - lo) * 0.15)
+                return math.exp(min(max(draw, lo), hi))
+            lo, hi = domain.low, domain.high
+            draw = self.rng.gauss(center, (hi - lo) * 0.15)
+            return min(max(draw, lo), hi)
+        if isinstance(domain, Randint):
+            center = self.rng.choice(vals)
+            span = max(1, (domain.high - domain.low) // 6)
+            draw = center + self.rng.randint(-span, span)
+            return min(max(draw, domain.low), domain.high - 1)
+        if isinstance(domain, (Choice, GridSearch)):
+            options = (domain.options if isinstance(domain, Choice)
+                       else domain.values)
+            # good histogram with +1 smoothing
+            weights = [1 + sum(1 for v in vals if v == o) for o in options]
+            return self.rng.choices(options, weights=weights)[0]
+        return domain
+
+    def _score(self, cfg: dict, good: list, bad: list) -> float:
+        """Sum of per-dim log(good density / bad density) via distance-based
+        kernel estimates; higher = more like good trials."""
+        import math
+
+        def density(vals, x, span):
+            if not vals:
+                return 1e-9
+            if isinstance(x, (int, float)) and span > 0:
+                h = span * 0.2
+                return sum(
+                    math.exp(-((x - v) ** 2) / (2 * h * h)) for v in vals
+                ) / len(vals) + 1e-9
+            return (sum(1 for v in vals if v == x) + 0.5) / (len(vals) + 1)
+
+        score = 0.0
+        for k, domain in self.param_space.items():
+            if not isinstance(domain, Domain) and not isinstance(
+                    domain, GridSearch):
+                continue
+            gv = [g[k] for g, _ in good if k in g]
+            bv = [b[k] for b, _ in bad if k in b]
+            if isinstance(domain, (Uniform, LogUniform)):
+                span = domain.high - domain.low
+            elif isinstance(domain, Randint):
+                span = domain.high - domain.low
+            else:
+                span = 0
+            x = cfg[k]
+            score += math.log(density(gv, x, span)) - math.log(
+                density(bv, x, span))
+        return score
+
+    def suggest(self, trial_id: str) -> dict:
+        if len(self._observed) < self.n_initial:
+            return self._sample_space()
+        good, bad = self._split()
+        best_cfg, best_score = None, None
+        for _ in range(self.n_candidates):
+            cfg = {}
+            for k, v in self.param_space.items():
+                if isinstance(v, (Domain, GridSearch)):
+                    cfg[k] = self._kernel_sample(k, v, good)
+                else:
+                    cfg[k] = v
+            s = self._score(cfg, good, bad)
+            if best_score is None or s > best_score:
+                best_cfg, best_score = cfg, s
+        return best_cfg

@@ -35,6 +35,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    search_alg: Any = None           # Searcher: adaptive config suggestion
     seed: int | None = None
     time_attr: str = "training_iteration"
 
@@ -121,20 +122,90 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self.resources = resources_per_trial or {"CPU": 1}
+        self._restored_trials: list[Trial] | None = None
+
+    # ---- experiment-level checkpoint / resume ----
+    # (ref: tune/execution/trial_runner.py:102 _ExperimentCheckpointManager)
+
+    def _experiment_dir(self) -> str | None:
+        if self.run_config.storage_path is None:
+            return None
+        import os
+
+        d = os.path.join(self.run_config.storage_path,
+                         self.run_config.name or "experiment")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _save_experiment(self, trials: list[Trial]) -> None:
+        d = self._experiment_dir()
+        if d is None:
+            return
+        import os
+        import pickle
+
+        state = [{
+            "trial_id": t.trial_id, "config": t.config, "state": t.state,
+            "reports": t.reports, "last_checkpoint": t.last_checkpoint,
+            "error": t.error, "failures": t.failures,
+            "iteration": t.iteration,
+        } for t in trials]
+        tmp = os.path.join(d, f"tuner.pkl.{os.getpid()}.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump({"trials": state, "param_space": self.param_space}, f)
+        os.replace(tmp, os.path.join(d, "tuner.pkl"))
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, **kwargs) -> "Tuner":
+        """Resume an experiment from `storage_path/name`. Finished trials
+        keep their results; unfinished trials restart from their last
+        checkpoint."""
+        import os
+        import pickle
+
+        with open(os.path.join(path, "tuner.pkl"), "rb") as f:
+            saved = pickle.load(f)
+        storage_path, name = os.path.split(path.rstrip("/"))
+        run_config = kwargs.pop("run_config", None) or RunConfig(
+            name=name, storage_path=storage_path)
+        tuner = cls(trainable, param_space=saved["param_space"],
+                    run_config=run_config, **kwargs)
+        trials = []
+        for s in saved["trials"]:
+            t = Trial(s["trial_id"], s["config"])
+            t.reports = s["reports"]
+            t.last_checkpoint = s["last_checkpoint"]
+            t.error = s["error"]
+            t.failures = s["failures"]
+            t.iteration = s["iteration"]
+            # In-flight trials resume from their last checkpoint.
+            t.state = TERMINATED if s["state"] == TERMINATED else PENDING
+            if s["state"] == ERROR:
+                t.state = ERROR
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner
 
     def fit(self, poll_interval: float = 0.15,
             timeout: float | None = None) -> ResultGrid:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
-        variants = BasicVariantGenerator(
-            self.param_space, tc.num_samples, tc.seed
-        ).variants()
-        trials = [
-            Trial(f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", cfg)
-            for i, cfg in enumerate(variants)
-        ]
+        searcher = tc.search_alg
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        elif searcher is not None:
+            # Adaptive: configs are suggested at launch time (below).
+            trials = []
+        else:
+            variants = BasicVariantGenerator(
+                self.param_space, tc.num_samples, tc.seed
+            ).variants()
+            trials = [
+                Trial(f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", cfg)
+                for i, cfg in enumerate(variants)
+            ]
         fn_blob = serialization.pack(self.trainable)
-        pending = list(trials)
+        pending = [t for t in trials if t.state == PENDING]
         running: list[Trial] = []
         max_failures = self.run_config.failure_config.max_failures
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -150,16 +221,40 @@ class Tuner:
             )
             trial.state = RUNNING
 
-        while pending or running:
+        def finish(trial: Trial) -> None:
+            if searcher is not None:
+                m = trial.last_metrics()
+                searcher.on_trial_complete(
+                    trial.trial_id,
+                    None if m is None else {**m, "config": trial.config})
+
+        n_created = len(trials)
+
+        def next_pending() -> Trial | None:
+            nonlocal n_created
+            if pending:
+                return pending.pop(0)
+            if searcher is not None and n_created < tc.num_samples:
+                tid = f"trial_{n_created:05d}_{uuid.uuid4().hex[:6]}"
+                t = Trial(tid, searcher.suggest(tid))
+                trials.append(t)
+                n_created += 1
+                return t
+            return None
+
+        while pending or running or (
+                searcher is not None and n_created < tc.num_samples):
             if deadline is not None and time.monotonic() > deadline:
                 for t in running:
                     self._stop_actor(t)
                     t.state = ERROR
                     t.error = "tune timeout"
                 break
-            while pending and len(running) < tc.max_concurrent_trials:
-                t = pending.pop(0)
-                launch(t)
+            while len(running) < tc.max_concurrent_trials:
+                t = next_pending()
+                if t is None:
+                    break
+                launch(t, t.last_checkpoint)
                 running.append(t)
             time.sleep(poll_interval)
             for t in list(running):
@@ -198,6 +293,7 @@ class Tuner:
                     self._stop_actor(t)
                     t.state = TERMINATED
                     running.remove(t)
+                    finish(t)
                 elif p["error"]:
                     t.failures += 1
                     if t.failures <= max_failures:
@@ -208,6 +304,7 @@ class Tuner:
                         t.error = p["error"]
                         self._stop_actor(t)
                         running.remove(t)
+                        finish(t)
                 elif p["done"]:
                     ck = self._fetch_checkpoint(t)
                     if ck is not None:
@@ -215,6 +312,9 @@ class Tuner:
                     t.state = TERMINATED
                     self._stop_actor(t)
                     running.remove(t)
+                    finish(t)
+            self._save_experiment(trials)
+        self._save_experiment(trials)
         return ResultGrid(trials, tc.metric, tc.mode)
 
     def _fetch_checkpoint(self, t: Trial):

@@ -1,0 +1,160 @@
+"""Tune widening: new schedulers, searcher plugin API (TPE), experiment
+checkpoint/resume."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune import (
+    HyperBandScheduler,
+    MedianStoppingRule,
+    RandomSearcher,
+    TPESearcher,
+    TuneConfig,
+    Tuner,
+)
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+
+class _T:
+    def __init__(self, trial_id):
+        self.trial_id = trial_id
+
+
+class TestSchedulersUnit:
+    def test_median_stopping_cuts_below_median(self):
+        rule = MedianStoppingRule(metric="acc", grace_period=1,
+                                  min_samples_required=3)
+        # Three healthy trials at step 1.
+        for tid, acc in [("a", 0.9), ("b", 0.8), ("c", 0.7)]:
+            assert rule.on_result(
+                _T(tid), {"acc": acc, "training_iteration": 1}) == CONTINUE
+        # A clearly-bad fourth trial is stopped.
+        assert rule.on_result(
+            _T("bad"), {"acc": 0.1, "training_iteration": 1}) == STOP
+        # A top trial continues.
+        assert rule.on_result(
+            _T("d"), {"acc": 0.95, "training_iteration": 1}) == CONTINUE
+
+    def test_hyperband_rungs_cut_bottom(self):
+        hb = HyperBandScheduler(metric="acc", max_t=9, eta=3)
+        assert hb.rungs == [1, 3, 9]
+        # At rung t=1: scores 0.9, 0.5, 0.1 → keep top 1/3 as they arrive.
+        assert hb.on_result(_T("a"), {"acc": 0.9,
+                                      "training_iteration": 1}) == CONTINUE
+        out_b = hb.on_result(_T("b"), {"acc": 0.5, "training_iteration": 1})
+        out_c = hb.on_result(_T("c"), {"acc": 0.1, "training_iteration": 1})
+        assert out_c == STOP
+        assert hb.on_result(_T("a"), {"acc": 0.9,
+                                      "training_iteration": 9}) == STOP
+
+
+class TestSearcherUnit:
+    def test_random_searcher_within_domain(self):
+        s = RandomSearcher({"lr": tune.loguniform(1e-4, 1e-1),
+                            "n": tune.randint(1, 5), "fixed": 3}, seed=0)
+        for i in range(10):
+            cfg = s.suggest(f"t{i}")
+            assert 1e-4 <= cfg["lr"] <= 1e-1
+            assert 1 <= cfg["n"] < 5
+            assert cfg["fixed"] == 3
+
+    def test_tpe_concentrates_near_optimum(self):
+        """Optimizing -(x-0.7)^2: after warmup, TPE suggestions should
+        cluster near 0.7 far more than uniform sampling would."""
+        space = {"x": tune.uniform(0.0, 1.0)}
+        s = TPESearcher(space, metric="score", seed=1, n_initial=8)
+        for i in range(40):
+            cfg = s.suggest(f"t{i}")
+            score = -(cfg["x"] - 0.7) ** 2
+            s.observe(cfg, score)
+        late = [s.suggest(f"probe{i}")["x"] for i in range(30)]
+        near = sum(1 for x in late if abs(x - 0.7) < 0.2)
+        assert near >= 20, (near, sorted(late))
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _trainable(config):
+    from ray_tpu.train import session
+
+    for i in range(3):
+        session.report({"score": config["x"] * (i + 1)},
+                       checkpoint={"step": i})
+
+
+class TestTunerIntegration:
+    def test_search_alg_drives_configs(self, cluster):
+        searcher = TPESearcher({"x": tune.uniform(0, 1)}, metric="score",
+                               seed=0, n_initial=2)
+        tuner = Tuner(
+            _trainable,
+            tune_config=TuneConfig(metric="score", mode="max", num_samples=4,
+                                   max_concurrent_trials=2,
+                                   search_alg=searcher),
+        )
+        grid = tuner.fit(timeout=300)
+        assert len(grid) == 4
+        assert len(searcher._observed) == 4
+        best = grid.get_best_result()
+        assert best.metrics["score"] > 0
+
+    def test_experiment_checkpoint_and_resume(self, cluster, tmp_path):
+        run_cfg = RunConfig(name="exp1", storage_path=str(tmp_path))
+        tuner = Tuner(
+            _trainable,
+            param_space={"x": tune.grid_search([0.1, 0.2, 0.3])},
+            tune_config=TuneConfig(metric="score", mode="max"),
+            run_config=run_cfg,
+        )
+        grid = tuner.fit(timeout=300)
+        assert len(grid) == 3
+        exp_dir = os.path.join(str(tmp_path), "exp1")
+        assert os.path.exists(os.path.join(exp_dir, "tuner.pkl"))
+
+        # Restore: all trials TERMINATED → nothing re-runs, results intact.
+        restored = Tuner.restore(exp_dir, _trainable)
+        grid2 = restored.fit(timeout=60)
+        assert len(grid2) == 3
+        assert grid2.get_best_result(
+            metric="score").metrics["score"] == pytest.approx(0.9)
+
+    def test_resume_reruns_unfinished_trials(self, cluster, tmp_path):
+        import pickle
+
+        exp_dir = str(tmp_path / "exp2")
+        os.makedirs(exp_dir)
+        # Simulated crash mid-experiment: one trial done, one mid-flight.
+        state = {
+            "param_space": {},
+            "trials": [
+                {"trial_id": "done", "config": {"x": 0.5}, "state":
+                 "TERMINATED",
+                 "reports": [{"score": 1.5, "training_iteration": 3}],
+                 "last_checkpoint": None, "error": None, "failures": 0,
+                 "iteration": 3},
+                {"trial_id": "mid", "config": {"x": 0.9}, "state": "RUNNING",
+                 "reports": [{"score": 0.9, "training_iteration": 1}],
+                 "last_checkpoint": {"step": 0}, "error": None,
+                 "failures": 0, "iteration": 1},
+            ],
+        }
+        with open(os.path.join(exp_dir, "tuner.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        restored = Tuner.restore(
+            exp_dir, _trainable,
+            tune_config=TuneConfig(metric="score", mode="max"))
+        grid = restored.fit(timeout=300)
+        by_id = {t.trial_id: t for t in grid.trials}
+        assert by_id["done"].state == "TERMINATED"
+        assert len(by_id["done"].reports) == 1  # untouched
+        assert by_id["mid"].state == "TERMINATED"
+        assert by_id["mid"].reports[-1]["score"] == pytest.approx(2.7)
