@@ -21,4 +21,8 @@ def __getattr__(name):
         from ray_tpu import api
 
         return getattr(api, name)
+    if name == "timeline":
+        from ray_tpu.state import timeline
+
+        return timeline
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -70,6 +70,9 @@ class GcsServer:
         self.subscribers: dict[str, set[rpc.Connection]] = {}
         self._job_counter = 0
         self._node_conns: dict[bytes, rpc.Connection] = {}
+        # Observability (ref: gcs_service.proto AddProfileData; metrics hub)
+        self.profile_events: list = []
+        self.metrics_by_source: dict[str, list] = {}
         self._register_handlers()
 
     # ---------- pubsub ----------
@@ -108,6 +111,10 @@ class GcsServer:
         s.register("obj_loc_remove", self._obj_loc_remove)
         s.register("obj_loc_get", self._obj_loc_get)
         s.register("obj_free", self._obj_free)
+        s.register("profile_add", self._profile_add)
+        s.register("profile_get", self._profile_get)
+        s.register("metrics_push", self._metrics_push)
+        s.register("metrics_get", self._metrics_get)
         s.on_disconnect(self._handle_disconnect)
 
     async def _register_node(self, conn, p):
@@ -166,6 +173,32 @@ class GcsServer:
         return JobID.from_int(self._job_counter).binary()
 
     # ---------- KV (ref: gcs_kv_manager.cc) ----------
+
+    # ---------- observability ----------
+
+    MAX_PROFILE_EVENTS = 200_000
+
+    async def _profile_add(self, conn, p):
+        room = self.MAX_PROFILE_EVENTS - len(self.profile_events)
+        if room > 0:
+            self.profile_events.extend(p["events"][:room])
+        return {"ok": True}
+
+    async def _profile_get(self, conn, p):
+        return self.profile_events
+
+    async def _metrics_push(self, conn, p):
+        # Latest snapshot per source process replaces the previous one.
+        self.metrics_by_source[p["source"]] = p["rows"]
+        return {"ok": True}
+
+    async def _metrics_get(self, conn, p):
+        out = []
+        for source, rows in self.metrics_by_source.items():
+            for r in rows:
+                out.append({**r, "tags": {**r.get("tags", {}),
+                                          "source": source}})
+        return out
 
     async def _kv_put(self, conn, p):
         ns = self.kv.setdefault(p.get("ns", ""), {})

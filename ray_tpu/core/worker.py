@@ -17,6 +17,7 @@ import logging
 import os
 import sys
 import threading
+import time
 import traceback
 from typing import Any
 
@@ -100,6 +101,7 @@ class Worker:
             os._exit(1)
 
         asyncio.ensure_future(_watch_raylet())
+        asyncio.ensure_future(self._obs_flush_loop())
         # Make this process usable as a client (nested tasks): api.init picks
         # these up lazily inside executing task code.
         os.environ["RAY_TPU_RAYLET_ADDRESS"] = (
@@ -133,8 +135,32 @@ class Worker:
 
     # ------------------------------------------------------------ execution
 
+    async def _obs_flush_loop(self) -> None:
+        """Ship buffered profile events + metric snapshots to the GCS
+        (ref: core_worker/profiling.cc batching to AddProfileData)."""
+        from ray_tpu import profiling
+
+        source = f"worker:{WorkerID(self.worker_id).hex()[:8]}"
+        while not self._exit.is_set():
+            await asyncio.sleep(1.0)
+            try:
+                events = profiling.drain_events()
+                if events:
+                    await self.gcs.call("profile_add", {"events": events},
+                                        timeout=10.0)
+                rows = profiling.metrics_snapshot()
+                if rows:
+                    await self.gcs.call(
+                        "metrics_push", {"source": source, "rows": rows},
+                        timeout=10.0)
+            except Exception:
+                pass
+
     async def _h_push_task(self, conn, p):
+        from ray_tpu import profiling
+
         spec: TaskSpec = p["spec"]
+        _t0 = time.time()
         if spec.kind == ACTOR_TASK:
             rt = self.actors.get(spec.actor_id)
             if rt is None:
@@ -151,6 +177,10 @@ class Worker:
                 self.task_pool, self._run_normal_task, spec
             )
         results, error = await fut
+        profiling.record_event(
+            spec.method_name or spec.name, spec.kind, _t0, time.time() - _t0,
+            pid=f"node:{self.node_id.hex()[:8]}",
+            tid=f"worker:{WorkerID(self.worker_id).hex()[:8]}")
         reply: dict[str, Any] = {"status": "ok", "worker_id": self.worker_id}
         if error is not None:
             reply["status"] = "error"

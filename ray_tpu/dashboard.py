@@ -55,6 +55,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(state.list_actors())
             if self.path == "/api/memory":
                 return self._json(state.object_store_stats())
+            if self.path == "/metrics":
+                body = state.prometheus_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if self.path == "/api/timeline":
+                return self._json(state.timeline())
             if self.path in ("/api/jobs", "/api/jobs/"):
                 return self._json(ray_tpu.get(
                     self.server.jobs.list.remote(), timeout=30))

@@ -1,0 +1,13 @@
+"""User-facing metrics API (ref: python/ray/util/metrics.py).
+
+    from ray_tpu.metrics import Counter
+    c = Counter("requests_total", description="...", tag_keys=("route",))
+    c.inc(1.0, tags={"route": "/gen"})
+
+Values recorded in workers are flushed to the GCS automatically and served
+in Prometheus exposition format at the dashboard's /metrics endpoint.
+"""
+
+from ray_tpu.profiling import Counter, Gauge, Histogram
+
+__all__ = ["Counter", "Gauge", "Histogram"]

@@ -1,0 +1,190 @@
+"""Profiling events + metrics: the observability pipeline.
+
+Parity: the reference batches per-worker profile events to the GCS
+(`/root/reference/src/ray/core_worker/profiling.cc`,
+`gcs_service.proto:255-259` AddProfileData) and dumps Chrome-trace JSON via
+`ray.timeline` (`_private/state.py:829`); metrics are OpenCensus
+counters/gauges/histograms (`src/ray/stats/metric.h:26`) exported for
+Prometheus (`_private/prometheus_exporter.py`).
+
+Here both ride the same flush: every process buffers events/metric values
+locally; workers flush to the GCS piggybacked on their existing connection
+(one-way notify, off the hot path), and `ray_tpu.timeline()` /
+the dashboard's `/metrics` endpoint read the aggregate back.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any
+
+# ---------------------------------------------------------------- events
+
+_events: list[dict] = []
+_events_lock = threading.Lock()
+MAX_BUFFER = 10_000
+
+
+def record_event(name: str, cat: str, start_s: float, dur_s: float,
+                 pid: str = "driver", tid: str = "main",
+                 args: dict | None = None) -> None:
+    """Record one complete ("X") span. Timestamps: time.time() seconds."""
+    ev = {
+        "name": name, "cat": cat, "ph": "X",
+        "ts": start_s * 1e6, "dur": dur_s * 1e6,
+        "pid": pid, "tid": tid,
+    }
+    if args:
+        ev["args"] = args
+    with _events_lock:
+        if len(_events) < MAX_BUFFER:
+            _events.append(ev)
+
+
+class span:
+    """with profiling.span("name", cat="custom"): ..."""
+
+    def __init__(self, name: str, cat: str = "custom", pid: str = "driver",
+                 tid: str = "main"):
+        self.name, self.cat, self.pid, self.tid = name, cat, pid, tid
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        record_event(self.name, self.cat, self.t0, time.time() - self.t0,
+                     self.pid, self.tid)
+        return False
+
+
+def drain_events() -> list[dict]:
+    with _events_lock:
+        out = _events[:]
+        _events.clear()
+    return out
+
+
+# ---------------------------------------------------------------- metrics
+
+class _Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: tuple = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        _registry[name] = self
+
+    def _key(self, tags: dict | None) -> tuple:
+        tags = tags or {}
+        return tuple(str(tags.get(k, "")) for k in self.tag_keys)
+
+    def snapshot(self) -> list[tuple[tuple, float]]:
+        with self._lock:
+            return list(self._values.items())
+
+    kind = "gauge"
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: dict | None = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(_Metric):
+    """Prometheus-style cumulative buckets."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: tuple = (0.01, 0.1, 1, 10, 100),
+                 tag_keys: tuple = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, value: float, tags: dict | None = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                k, [0] * (len(self.boundaries) + 1))
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._values[k] = sum(counts)  # observation count
+
+    def snapshot_hist(self):
+        with self._lock:
+            return ({k: list(v) for k, v in self._counts.items()},
+                    dict(self._sums))
+
+
+_registry: dict[str, _Metric] = {}
+
+
+def metrics_snapshot() -> list[dict]:
+    """Flushable view of this process's metrics."""
+    out = []
+    for m in list(_registry.values()):
+        for key, value in m.snapshot():
+            out.append({
+                "name": m.name, "kind": m.kind, "description": m.description,
+                "tags": dict(zip(m.tag_keys, key)), "value": value,
+            })
+    return out
+
+
+def prometheus_text(rows: list[dict]) -> str:
+    """Render aggregated metric rows in Prometheus exposition format.
+    Counter rows with identical (name, tags) are summed; gauges keep the
+    last value per source (caller pre-labels sources if needed)."""
+    agg: dict[tuple, float] = {}
+    meta: dict[str, tuple[str, str]] = {}
+    for r in rows:
+        tags = tuple(sorted(r.get("tags", {}).items()))
+        key = (r["name"], tags)
+        meta[r["name"]] = (r["kind"], r.get("description", ""))
+        if r["kind"] == "counter":
+            agg[key] = agg.get(key, 0.0) + r["value"]
+        else:
+            agg[key] = r["value"]
+    lines = []
+    seen_names = set()
+    for (name, tags), value in sorted(agg.items()):
+        if name not in seen_names:
+            kind, desc = meta[name]
+            if desc:
+                lines.append(f"# HELP {name} {desc}")
+            lines.append(f"# TYPE {name} {kind if kind != 'histogram' else 'gauge'}")
+            seen_names.add(name)
+        label = ",".join(f'{k}="{v}"' for k, v in tags)
+        lines.append(f"{name}{{{label}}} {value}" if label
+                     else f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------- timeline
+
+def chrome_trace(events: list[dict]) -> str:
+    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})

@@ -160,6 +160,14 @@ def cmd_memory(args) -> None:
               f"({row['spilled']} spilled, native={row['native_allocator']})")
 
 
+def cmd_timeline(args) -> None:
+    from ray_tpu import state
+
+    _attach(args)
+    events = state.timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+
+
 def cmd_job(args) -> None:
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -217,6 +225,12 @@ def main(argv: list[str] | None = None) -> None:
     sp = sub.add_parser("memory", help="object store stats per node")
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("timeline",
+                        help="dump chrome-trace JSON of task execution")
+    sp.add_argument("-o", "--output", default="timeline.json")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser("job", help="job submission")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

@@ -89,6 +89,36 @@ def object_store_stats() -> list[dict]:
     return out
 
 
+def timeline(filename: str | None = None):
+    """Chrome-trace JSON of task/actor execution spans collected from all
+    workers (ref: `_private/state.py:829` ray.timeline). Open in
+    chrome://tracing or Perfetto. Returns the event list; writes the trace
+    to `filename` when given."""
+    from ray_tpu import profiling
+
+    events = list(_call_gcs("profile_get")) + profiling.drain_events()
+    if filename:
+        with open(filename, "w") as f:
+            f.write(profiling.chrome_trace(events))
+    return events
+
+
+def metrics_rows() -> list[dict]:
+    """Aggregated metric rows from every reporting process."""
+    from ray_tpu import profiling
+
+    rows = list(_call_gcs("metrics_get"))
+    rows += [{**r, "tags": {**r["tags"], "source": "driver"}}
+             for r in profiling.metrics_snapshot()]
+    return rows
+
+
+def prometheus_metrics() -> str:
+    from ray_tpu import profiling
+
+    return profiling.prometheus_text(metrics_rows())
+
+
 def cluster_status() -> dict:
     """Summary used by `status` CLI and the dashboard."""
     nodes = list_nodes()

@@ -1,0 +1,107 @@
+"""Observability: profile events → timeline, metrics → Prometheus text."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import profiling, state
+from ray_tpu.metrics import Counter, Gauge, Histogram
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestMetricsUnit:
+    def test_counter_gauge_histogram(self):
+        c = Counter("t_requests", tag_keys=("route",))
+        c.inc(2.0, tags={"route": "a"})
+        c.inc(1.0, tags={"route": "a"})
+        c.inc(5.0, tags={"route": "b"})
+        g = Gauge("t_temp")
+        g.set(42.0)
+        h = Histogram("t_lat", boundaries=(1, 10))
+        h.observe(0.5)
+        h.observe(5)
+        h.observe(100)
+        rows = profiling.metrics_snapshot()
+        by = {(r["name"], tuple(r["tags"].items())): r["value"] for r in rows}
+        assert by[("t_requests", (("route", "a"),))] == 3.0
+        assert by[("t_requests", (("route", "b"),))] == 5.0
+        assert by[("t_temp", ())] == 42.0
+        assert by[("t_lat", ())] == 3  # observation count
+
+    def test_prometheus_text_sums_counters(self):
+        rows = [
+            {"name": "x_total", "kind": "counter", "tags": {"s": "w1"},
+             "value": 2.0},
+            {"name": "x_total", "kind": "counter", "tags": {"s": "w1"},
+             "value": 3.0},
+        ]
+        text = profiling.prometheus_text(rows)
+        assert 'x_total{s="w1"} 5.0' in text
+        assert "# TYPE x_total counter" in text
+
+
+class TestTimeline:
+    def test_task_spans_reach_timeline(self, cluster, tmp_path):
+        @ray_tpu.remote
+        def traced_task(ms):
+            time.sleep(ms / 1000)
+            return ms
+
+        ray_tpu.get([traced_task.remote(30) for _ in range(4)])
+        # Workers flush on a 1s cadence.
+        deadline = time.monotonic() + 15
+        events = []
+        while time.monotonic() < deadline:
+            events = [e for e in state.timeline()
+                      if e["name"] == "traced_task"]
+            if len(events) >= 4:
+                break
+            time.sleep(0.5)
+        assert len(events) >= 4, events[:3]
+        ev = events[0]
+        assert ev["ph"] == "X" and ev["dur"] >= 30_000  # ≥30ms in µs
+        assert ev["tid"].startswith("worker:")
+
+        out = str(tmp_path / "trace.json")
+        state.timeline(out)
+        trace = json.load(open(out))
+        assert any(e["name"] == "traced_task" for e in trace["traceEvents"])
+
+    def test_driver_span_and_custom_metrics_flow(self, cluster):
+        @ray_tpu.remote
+        def with_metric():
+            from ray_tpu.metrics import Counter
+
+            Counter("app_things_total").inc(7.0)
+            return True
+
+        assert ray_tpu.get(with_metric.remote(), timeout=60)
+        deadline = time.monotonic() + 15
+        text = ""
+        while time.monotonic() < deadline:
+            text = state.prometheus_metrics()
+            if "app_things_total" in text:
+                break
+            time.sleep(0.5)
+        assert "app_things_total" in text, text
+
+    def test_dashboard_metrics_endpoint(self, cluster):
+        from ray_tpu.dashboard import start_dashboard
+
+        dash = start_dashboard(port=0)
+        try:
+            with urllib.request.urlopen(dash.url + "/metrics",
+                                        timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                r.read()
+        finally:
+            dash.stop()
