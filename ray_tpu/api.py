@@ -191,7 +191,7 @@ _TASK_ONLY = {"num_returns", "max_retries"}
 _ACTOR_ONLY = {"max_restarts", "max_concurrency", "name", "get_if_exists",
                "lifetime", "max_task_retries"}
 _COMMON = {"num_cpus", "num_tpus", "resources", "scheduling_strategy",
-           "runtime_env", "placement_group"}
+           "runtime_env", "placement_group", "placement_group_bundle_index"}
 
 
 def _build_resources(opts: dict) -> dict[str, float]:

@@ -68,6 +68,29 @@ class Cluster:
             self.worker_nodes.append(node)
         return node
 
+    def wait_for_nodes(self, n: int, timeout: float = 30.0) -> None:
+        """Block until `n` alive nodes are registered with the GCS
+        (ref: cluster_utils.py wait_for_nodes)."""
+        import asyncio
+        import time
+
+        from ray_tpu.core import rpc
+
+        async def count() -> int:
+            conn = await rpc.connect(*self.gcs_address, timeout=10.0)
+            try:
+                view = await conn.call("get_cluster_view", {})
+                return sum(1 for v in view.values() if v.get("alive", True))
+            finally:
+                await conn.close()
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if asyncio.run(count()) >= n:
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"cluster did not reach {n} alive nodes")
+
     def remove_node(self, node: Node) -> None:
         """Hard-kill a node (raylet + its workers die with it)."""
         node.stop()

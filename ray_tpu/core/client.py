@@ -794,6 +794,21 @@ class CoreClient:
             except Exception:
                 pass
 
+    # -------------------------------------------------- placement groups
+
+    def create_placement_group(self, pg_id: bytes, bundles: list,
+                               strategy: str, name: str = "") -> dict:
+        return self._run(self.gcs.call("pg_create", {
+            "pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+            "name": name,
+        }), timeout=60)
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        self._run(self.gcs.call("pg_remove", {"pg_id": pg_id}), timeout=60)
+
+    def list_placement_groups(self) -> list:
+        return self._run(self.gcs.call("pg_list", {}), timeout=30)
+
     def get_named_actor(self, name: str) -> bytes | None:
         info = self._run(self.gcs.call("get_actor", {"name": name}))
         if info is None or info["state"] == "DEAD":

@@ -70,6 +70,9 @@ class GcsServer:
         self.subscribers: dict[str, set[rpc.Connection]] = {}
         self._job_counter = 0
         self._node_conns: dict[bytes, rpc.Connection] = {}
+        # pg_id → {"bundles": [{"index", "resources", "node_id"}],
+        #          "strategy", "state", "name"}
+        self.placement_groups: dict[bytes, dict] = {}
         # Observability (ref: gcs_service.proto AddProfileData; metrics hub)
         self.profile_events: list = []
         self.metrics_by_source: dict[str, list] = {}
@@ -111,6 +114,10 @@ class GcsServer:
         s.register("obj_loc_remove", self._obj_loc_remove)
         s.register("obj_loc_get", self._obj_loc_get)
         s.register("obj_free", self._obj_free)
+        s.register("pg_create", self._pg_create)
+        s.register("pg_remove", self._pg_remove)
+        s.register("pg_get", self._pg_get)
+        s.register("pg_list", self._pg_list)
         s.register("profile_add", self._profile_add)
         s.register("profile_get", self._profile_get)
         s.register("metrics_push", self._metrics_push)
@@ -173,6 +180,149 @@ class GcsServer:
         return JobID.from_int(self._job_counter).binary()
 
     # ---------- KV (ref: gcs_kv_manager.cc) ----------
+
+    # ---------- placement groups ----------
+    # (ref: gcs_placement_group_manager.cc + gcs_placement_group_scheduler.cc
+    #  two-phase bundle reservation; strategies common.proto:758-765)
+
+    def _place_bundles(self, bundles: list[dict], strategy: str):
+        """→ list of node_ids per bundle, or None if infeasible. Packing is
+        simulated against a copy of each node's available resources."""
+        alive = [(nid, dict(n.resources_available))
+                 for nid, n in self.nodes.items() if n.alive]
+        if not alive:
+            return None
+
+        def fits(free, res):
+            return all(free.get(k, 0) >= v for k, v in res.items())
+
+        def consume(free, res):
+            for k, v in res.items():
+                free[k] = free.get(k, 0) - v
+
+        placement: list[bytes] = []
+        if strategy in ("PACK", "STRICT_PACK"):
+            # Try to fit the whole group on one node (STRICT requires it).
+            for nid, free in alive:
+                trial = dict(free)
+                ok = True
+                for b in bundles:
+                    if not fits(trial, b):
+                        ok = False
+                        break
+                    consume(trial, b)
+                if ok:
+                    return [nid] * len(bundles)
+            if strategy == "STRICT_PACK":
+                return None
+            # PACK fallback: greedy first-fit across nodes.
+            for b in bundles:
+                for nid, free in alive:
+                    if fits(free, b):
+                        consume(free, b)
+                        placement.append(nid)
+                        break
+                else:
+                    return None
+            return placement
+        # SPREAD / STRICT_SPREAD: distinct nodes, round-robin.
+        used: set[bytes] = set()
+        for b in bundles:
+            chosen = None
+            for nid, free in alive:
+                if nid in used or not fits(free, b):
+                    continue
+                chosen = (nid, free)
+                break
+            if chosen is None:
+                if strategy == "STRICT_SPREAD":
+                    return None
+                for nid, free in alive:  # soft spread: reuse nodes
+                    if fits(free, b):
+                        chosen = (nid, free)
+                        break
+                if chosen is None:
+                    return None
+            consume(chosen[1], b)
+            used.add(chosen[0])
+            placement.append(chosen[0])
+        return placement
+
+    async def _pg_create(self, conn, p):
+        pg_id = p["pg_id"]
+        bundles = p["bundles"]
+        strategy = p["strategy"]
+        placement = self._place_bundles(bundles, strategy)
+        if placement is None:
+            return {"ok": False,
+                    "error": f"infeasible: {strategy} {bundles}"}
+        reserved: list[tuple[bytes, int]] = []
+        for i, (node_id, res) in enumerate(zip(placement, bundles)):
+            node_conn = self._node_conns.get(node_id)
+            try:
+                r = await node_conn.call("pg_reserve", {
+                    "pg_id": pg_id, "bundle_index": i, "resources": res,
+                }, timeout=10.0)
+            except Exception as e:
+                r = {"ok": False, "error": repr(e)}
+            if not r.get("ok"):
+                # Rollback phase-1 reservations.
+                for node_id2, j in reserved:
+                    c2 = self._node_conns.get(node_id2)
+                    if c2 is not None:
+                        try:
+                            await c2.call("pg_return", {
+                                "pg_id": pg_id, "bundle_index": j,
+                            }, timeout=10.0)
+                        except Exception:
+                            pass
+                return {"ok": False, "error": r.get("error", "reserve failed")}
+            reserved.append((node_id, i))
+            # Keep the GCS resource view in sync immediately (heartbeats
+            # would catch up anyway).
+            info = self.nodes.get(node_id)
+            if info is not None:
+                for k, v in res.items():
+                    info.resources_available[k] = (
+                        info.resources_available.get(k, 0) - v)
+        self.placement_groups[pg_id] = {
+            "bundles": [
+                {"index": i, "resources": b, "node_id": nid}
+                for i, (nid, b) in enumerate(zip(placement, bundles))
+            ],
+            "strategy": strategy,
+            "state": "CREATED",
+            "name": p.get("name", ""),
+        }
+        return {"ok": True, "bundles": self.placement_groups[pg_id]["bundles"]}
+
+    async def _pg_remove(self, conn, p):
+        pg = self.placement_groups.pop(p["pg_id"], None)
+        if pg is None:
+            return {"ok": False}
+        for b in pg["bundles"]:
+            node_conn = self._node_conns.get(b["node_id"])
+            if node_conn is not None:
+                try:
+                    await node_conn.call("pg_return", {
+                        "pg_id": p["pg_id"], "bundle_index": b["index"],
+                    }, timeout=10.0)
+                except Exception:
+                    pass
+            # Keep the GCS view in sync (mirror of pg_create's decrement).
+            info = self.nodes.get(b["node_id"])
+            if info is not None:
+                for k, v in b["resources"].items():
+                    info.resources_available[k] = (
+                        info.resources_available.get(k, 0) + v)
+        return {"ok": True}
+
+    async def _pg_get(self, conn, p):
+        return self.placement_groups.get(p["pg_id"])
+
+    async def _pg_list(self, conn, p):
+        return [{"pg_id": pid, **pg}
+                for pid, pg in self.placement_groups.items()]
 
     # ---------- observability ----------
 

@@ -41,6 +41,7 @@ class WorkerHandle:
     idle: bool = True
     actor_id: bytes | None = None            # pinned if hosting an actor
     lease_resources: dict[str, float] = field(default_factory=dict)
+    bundle_key: tuple | None = None          # (pg_id, index) when PG-backed
     started: float = field(default_factory=time.monotonic)
     proc: Any = None
 
@@ -50,6 +51,7 @@ class LeaseRequest:
     resources: dict[str, float]
     strategy: Any
     future: asyncio.Future
+    bundle_key: tuple | None = None          # grant from this PG bundle
     enqueued: float = field(default_factory=time.monotonic)
 
 
@@ -87,6 +89,10 @@ class Raylet:
         # unpin drains zombies before live entries in that same order.
         self._conn_pins: dict[int, dict] = {}
         self.lease_queue: list[LeaseRequest] = []
+        # (pg_id, bundle_index) → {"total": res, "free": res}. Reserved out
+        # of resources_available via the GCS 2PC (ref: node_manager.proto:
+        # 377-384 PrepareBundle/CommitBundle).
+        self.pg_bundles: dict[tuple, dict] = {}
         self.gcs: rpc.Connection | None = None
         self.cluster_view: dict[bytes, dict] = {}
         self._pulls_inflight: dict[bytes, asyncio.Future] = {}
@@ -112,6 +118,9 @@ class Raylet:
         s.register("store_free", self._h_store_free)
         s.register("store_stats", self._h_store_stats)
         s.register("store_pin", self._h_store_pin)
+        # placement groups (GCS-driven bundle reservation)
+        s.register("pg_reserve", self._h_pg_reserve)
+        s.register("pg_return", self._h_pg_return)
         # object plane (remote raylets)
         s.register("obj_read_chunk", self._h_obj_read_chunk)
         s.register("obj_info", self._h_obj_info)
@@ -121,10 +130,20 @@ class Raylet:
     async def start(self) -> tuple[str, int]:
         addr = await self.server.start()
         self.address = addr
+        async def _gcs_request(method: str, payload: Any):
+            # The GCS drives raylet-side actions (bundle reservation, …)
+            # back over this same connection; dispatch into the normal
+            # handler table.
+            fn = self.server._handlers.get(method)
+            if fn is None:
+                raise rpc.RpcError(f"unknown method {method!r}")
+            return await fn(self.gcs, payload)
+
         self.gcs = await rpc.connect(
             *self.gcs_address,
             timeout=self.config.rpc_connect_timeout_s,
             notify_handler=self._gcs_notify,
+            request_handler=_gcs_request,
         )
         await self.gcs.call("register_node", {
             "node_id": self.node_id,
@@ -257,9 +276,19 @@ class Raylet:
                 self._pump_leases()
 
     def _return_resources(self, h: WorkerHandle) -> None:
-        for k, v in h.lease_resources.items():
-            self.resources_available[k] = self.resources_available.get(k, 0) + v
+        bundle = (self.pg_bundles.get(h.bundle_key)
+                  if h.bundle_key is not None else None)
+        if bundle is not None:
+            for k, v in h.lease_resources.items():
+                bundle["free"][k] = bundle["free"].get(k, 0) + v
+        else:
+            # Plain lease — or the PG was removed mid-lease, in which case
+            # the bundle's reservation already went back minus this share.
+            for k, v in h.lease_resources.items():
+                self.resources_available[k] = (
+                    self.resources_available.get(k, 0) + v)
         h.lease_resources = {}
+        h.bundle_key = None
 
     async def _reap_idle_loop(self) -> None:
         while not self._shutdown:
@@ -312,9 +341,41 @@ class Raylet:
                 best, best_score = tuple(n["address"]), score
         return best
 
+    async def _h_pg_reserve(self, conn, p):
+        """Carve a bundle out of this node's available resources."""
+        key = (p["pg_id"], p["bundle_index"])
+        if key in self.pg_bundles:
+            return {"ok": True}  # idempotent retry
+        res = p["resources"]
+        if not self._available(res):
+            return {"ok": False, "error": "insufficient resources"}
+        for k, v in res.items():
+            self.resources_available[k] = self.resources_available.get(k, 0) - v
+        self.pg_bundles[key] = {"total": dict(res), "free": dict(res)}
+        return {"ok": True}
+
+    async def _h_pg_return(self, conn, p):
+        key = (p["pg_id"], p["bundle_index"])
+        b = self.pg_bundles.pop(key, None)
+        if b is not None:
+            # Outstanding leases from this bundle return their share to the
+            # node directly when released (bundle record is gone by then).
+            for k, v in b["free"].items():
+                self.resources_available[k] = (
+                    self.resources_available.get(k, 0) + v)
+            self._pump_leases()
+        return {"ok": True}
+
+    def _bundle_fits(self, key: tuple, resources: dict) -> bool:
+        b = self.pg_bundles.get(key)
+        return b is not None and all(
+            b["free"].get(k, 0) >= v for k, v in resources.items())
+
     async def _h_request_lease(self, conn, p):
         resources = p.get("resources", {})
         strategy = p.get("strategy")
+        if isinstance(strategy, dict) and strategy.get("type") == "placement_group":
+            return await self._lease_from_bundle(p, resources, strategy)
         affinity = None
         if isinstance(strategy, dict) and strategy.get("type") == "node_affinity":
             affinity = strategy
@@ -357,13 +418,55 @@ class Raylet:
                 self.lease_queue.remove(req)
             return {"error": "lease timeout"}
 
+    async def _lease_from_bundle(self, p, resources, strategy):
+        """Grant a lease out of a reserved PG bundle on this node, or
+        spill to the node holding the bundle."""
+        pg_id = strategy["pg_id"]
+        index = strategy.get("bundle_index", -1)
+        local_keys = ([(pg_id, index)] if index >= 0 else
+                      sorted(k for k in self.pg_bundles if k[0] == pg_id))
+        key = next((k for k in local_keys
+                    if k in self.pg_bundles
+                    and all(self.pg_bundles[k]["total"].get(rk, 0) >= rv
+                            for rk, rv in resources.items())), None)
+        if key is None:
+            # Bundle lives elsewhere: ask the GCS where and spill there.
+            info = await self.gcs.call("pg_get", {"pg_id": pg_id})
+            if info is None:
+                return {"error": f"placement group {pg_id.hex()[:12]} not found"}
+            for b in info["bundles"]:
+                if index >= 0 and b["index"] != index:
+                    continue
+                if b["node_id"] == self.node_id:
+                    continue
+                target = self.cluster_view.get(b["node_id"])
+                if target is not None and target.get("alive", True):
+                    return {"spillback": tuple(target["address"])}
+            return {"error": "no alive node holds the requested bundle"}
+        req = LeaseRequest(
+            resources=resources, strategy=strategy, bundle_key=key,
+            future=asyncio.get_running_loop().create_future(),
+        )
+        self.lease_queue.append(req)
+        self._pump_leases()
+        try:
+            return await asyncio.wait_for(
+                req.future, p.get("timeout", self.config.lease_timeout_s))
+        except asyncio.TimeoutError:
+            if req in self.lease_queue:
+                self.lease_queue.remove(req)
+            return {"error": "lease timeout (bundle busy)"}
+
     def _pump_leases(self) -> None:
         granted = []
         for req in self.lease_queue:
             if req.future.done():
                 granted.append(req)
                 continue
-            if not self._available(req.resources):
+            if req.bundle_key is not None:
+                if not self._bundle_fits(req.bundle_key, req.resources):
+                    continue
+            elif not self._available(req.resources):
                 continue
             worker = self._find_idle_worker()
             if worker is None:
@@ -382,10 +485,16 @@ class Raylet:
                 continue
             worker.idle = False
             worker.lease_resources = dict(req.resources)
-            for k, v in req.resources.items():
-                self.resources_available[k] = (
-                    self.resources_available.get(k, 0) - v
-                )
+            worker.bundle_key = req.bundle_key
+            if req.bundle_key is not None:
+                free = self.pg_bundles[req.bundle_key]["free"]
+                for k, v in req.resources.items():
+                    free[k] = free.get(k, 0) - v
+            else:
+                for k, v in req.resources.items():
+                    self.resources_available[k] = (
+                        self.resources_available.get(k, 0) - v
+                    )
             req.future.set_result({
                 "worker_id": worker.worker_id,
                 "worker_address": worker.address,
@@ -404,15 +513,24 @@ class Raylet:
     async def _h_release_lease(self, conn, p):
         h = self.workers.get(p["worker_id"])
         if h is not None:
+            bundle_key = h.bundle_key
             self._return_resources(h)
             if p.get("actor_id"):
                 h.actor_id = p["actor_id"]       # pinned to actor: not reusable
-                # actor holds its resources for life
+                # actor holds its resources for life — from the same pool
+                # (PG bundle or node) its creation lease came from
                 h.lease_resources = p.get("resources", {})
-                for k, v in h.lease_resources.items():
-                    self.resources_available[k] = (
-                        self.resources_available.get(k, 0) - v
-                    )
+                bundle = (self.pg_bundles.get(bundle_key)
+                          if bundle_key is not None else None)
+                if bundle is not None:
+                    h.bundle_key = bundle_key
+                    for k, v in h.lease_resources.items():
+                        bundle["free"][k] = bundle["free"].get(k, 0) - v
+                else:
+                    for k, v in h.lease_resources.items():
+                        self.resources_available[k] = (
+                            self.resources_available.get(k, 0) - v
+                        )
             elif p.get("dead"):
                 self.workers.pop(p["worker_id"], None)
             else:

@@ -62,12 +62,35 @@ class GPTConfig:
         )
 
     @classmethod
+    def opt_1_3b(cls, **kw) -> "GPTConfig":
+        """OPT-1.3B-class decoder (BASELINE config 5 serving target)."""
+        return cls(
+            d_model=2048, n_layers=24, n_heads=32, d_ff=8192,
+            rotary_dim=64, tie_embeddings=False, remat=True, **kw
+        )
+
+    @classmethod
     def tiny(cls, **kw) -> "GPTConfig":
         """For tests / dryruns on CPU meshes."""
         kw.setdefault("vocab_size", 256)
         kw.setdefault("max_seq", 128)
         kw.setdefault("rotary_dim", 4)
         return cls(d_model=64, n_layers=2, n_heads=8, d_ff=128, **kw)
+
+    @classmethod
+    def tiny_untied(cls, **kw) -> "GPTConfig":
+        """Tiny with the big-model head/embedding layout (gptj/opt style)."""
+        kw.setdefault("tie_embeddings", False)
+        return cls.tiny(**kw)
+
+    _REGISTRY = ("gpt2_124m", "gpt2_350m", "gptj_6b", "opt_1_3b", "tiny",
+                 "tiny_untied")
+
+    @classmethod
+    def by_name(cls, name: str, **kw) -> "GPTConfig":
+        if name not in cls._REGISTRY:
+            raise KeyError(f"unknown model {name!r}; one of {cls._REGISTRY}")
+        return getattr(cls, name)(**kw)
 
 
 def param_specs(cfg: GPTConfig) -> dict[str, dict[str, Any]]:

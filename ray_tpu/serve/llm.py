@@ -265,8 +265,7 @@ class LLMDeployment:
             jax.config.update("jax_platforms", jax_platform)
         from ray_tpu.models import gpt
 
-        cfg_factory = getattr(gpt.GPTConfig, model)
-        cfg = cfg_factory()
+        cfg = gpt.GPTConfig.by_name(model)
         params = None
         if params_checkpoint:
             from ray_tpu.train.checkpoint import Checkpoint

@@ -97,6 +97,31 @@ class TestDecodePath:
         assert draws <= {0, 1, 2, 3} and 1 in draws
 
 
+class TestModelRegistry:
+    def test_by_name_and_param_counts(self):
+        for name, lo, hi in (("gpt2_124m", 0.1e9, 0.15e9),
+                             ("opt_1_3b", 1.2e9, 1.5e9),
+                             ("gptj_6b", 5.8e9, 6.3e9)):
+            c = gpt.GPTConfig.by_name(name)
+            assert lo < gpt.num_params(c) < hi, name
+        with pytest.raises(KeyError):
+            gpt.GPTConfig.by_name("nope")
+
+    def test_untied_decode_matches_forward(self):
+        """gptj/opt-style untied head through the cache path."""
+        cfg = gpt.GPTConfig.by_name("tiny_untied", dtype=jnp.float32)
+        params = gpt.init_params(cfg, jax.random.key(7))
+        prompt = [3, 14, 15, 9]
+        cache = init_kv_cache(cfg, 2, 32)
+        pad = np.zeros((1, 8), np.int32)
+        pad[0, :4] = prompt
+        last, cache = prefill(cfg, params, jnp.asarray(pad), cache,
+                              jnp.int32(0), jnp.int32(4))
+        full = gpt.forward(params, jnp.asarray([prompt]), cfg)
+        np.testing.assert_allclose(np.asarray(last), np.asarray(full[0, -1]),
+                                   rtol=2e-4, atol=2e-4)
+
+
 class TestContinuousBatching:
     def test_midflight_admission(self, params):
         """A request submitted while another is decoding joins without
