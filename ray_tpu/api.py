@@ -244,6 +244,7 @@ class RemoteFunction:
             resources=_build_resources(o),
             max_retries=o.get("max_retries"),
             scheduling_strategy=_strategy_payload(o),
+            runtime_env=o.get("runtime_env"),
         )
         return refs[0] if o.get("num_returns", 1) == 1 else refs
 
@@ -346,6 +347,7 @@ class ActorClass:
             max_concurrency=o.get("max_concurrency", 1),
             actor_name=o.get("name"),
             get_if_exists=o.get("get_if_exists", False),
+            runtime_env=o.get("runtime_env"),
         )
         return ActorHandle(ActorID(actor_id))
 

@@ -317,8 +317,12 @@ class CoreClient:
         resources: dict[str, float] | None = None,
         max_retries: int | None = None,
         scheduling_strategy: Any = None,
+        runtime_env: dict | None = None,
     ) -> list:
         from ray_tpu.api import ObjectRef
+        from ray_tpu.core.runtime_env import resolve_runtime_env
+
+        runtime_env = resolve_runtime_env(runtime_env, self)
 
         task_id = TaskID.for_task(JobID(self.job_id))
         arg_specs, kw_keys = self._build_args(args, kwargs)
@@ -342,6 +346,7 @@ class CoreClient:
                 if max_retries is None else max_retries
             ),
             scheduling_strategy=scheduling_strategy,
+            runtime_env=runtime_env,
         )
         for rid in return_ids:
             ev = threading.Event()
@@ -462,7 +467,11 @@ class CoreClient:
         max_concurrency: int = 1,
         actor_name: str | None = None,
         get_if_exists: bool = False,
+        runtime_env: dict | None = None,
     ) -> bytes:
+        from ray_tpu.core.runtime_env import resolve_runtime_env
+
+        runtime_env = resolve_runtime_env(runtime_env, self)
         actor_id = ActorID.of(JobID(self.job_id)).binary()
         resources = resources or {"CPU": 1}
         st = ActorState(actor_id)
@@ -471,6 +480,7 @@ class CoreClient:
         result = self._run(self._create_actor_async(
             st, cls_blob, name, args, kwargs, resources, hold_resources,
             max_restarts, max_concurrency, actor_name, get_if_exists,
+            runtime_env,
         ))
         if isinstance(result, bytes):       # got existing named actor
             return result
@@ -479,6 +489,7 @@ class CoreClient:
     async def _create_actor_async(
         self, st, cls_blob, name, args, kwargs, resources, hold_resources,
         max_restarts, max_concurrency, actor_name, get_if_exists,
+        runtime_env=None,
     ):
         task_id = TaskID.for_actor_task(ActorID(st.actor_id))
         arg_specs, kw_keys = self._build_args(args, kwargs)
@@ -498,6 +509,7 @@ class CoreClient:
             max_restarts=max_restarts,
             max_concurrency=max_concurrency,
             actor_name=actor_name,
+            runtime_env=runtime_env,
         )
         reg = await self.gcs.call("register_actor", {
             "actor_id": st.actor_id,
@@ -793,6 +805,18 @@ class CoreClient:
                 self._run(_send_kill())
             except Exception:
                 pass
+
+    # -------------------------------------------------- kv
+
+    def kv_put(self, ns: str, key: bytes, value: bytes,
+               overwrite: bool = True) -> None:
+        self._run(self.gcs.call("kv_put", {
+            "ns": ns, "key": key, "value": value, "overwrite": overwrite,
+        }), timeout=60)
+
+    def kv_get(self, ns: str, key: bytes):
+        return self._run(self.gcs.call("kv_get", {"ns": ns, "key": key}),
+                         timeout=60)
 
     # -------------------------------------------------- placement groups
 

@@ -1,0 +1,97 @@
+"""Runtime environments: per-task/actor env vars + working_dir shipping.
+
+Parity: `/root/reference/python/ray/_private/runtime_env/` — the two
+plugins that matter for a single-image TPU fleet: `env_vars` (applied in
+the worker before user code runs) and `working_dir` (directory zipped by
+the submitter, content-addressed in the GCS KV as the reference does with
+its package URIs (`runtime_env/packaging.py`), extracted + sys.path'd on
+the executing node, cached by digest). Conda/container plugins are a
+deliberate non-goal: TPU hosts run one prebuilt image.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+
+MAX_WORKING_DIR_BYTES = 100 * 1024 * 1024
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def package_working_dir(path: str) -> tuple[str, bytes]:
+    """Zip a directory → (content digest, zip bytes)."""
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for fn in sorted(files):
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, path)
+                total += os.path.getsize(full)
+                if total > MAX_WORKING_DIR_BYTES:
+                    raise ValueError(
+                        f"working_dir {path} exceeds "
+                        f"{MAX_WORKING_DIR_BYTES >> 20} MiB")
+                zf.write(full, rel)
+    data = buf.getvalue()
+    return hashlib.sha256(data).hexdigest()[:32], data
+
+
+def resolve_runtime_env(env: dict | None, client) -> dict | None:
+    """Submitter side: upload working_dir once (content-addressed KV),
+    rewrite the env to reference the URI."""
+    if not env:
+        return env
+    out = dict(env)
+    wd = out.pop("working_dir", None)
+    if wd:
+        digest, data = package_working_dir(wd)
+        key = f"pkg:{digest}".encode()
+        if client.kv_get("runtime_env", key) is None:
+            client.kv_put("runtime_env", key, data)
+        out["working_dir_uri"] = digest
+    return out
+
+
+_applied_dirs: dict[str, str] = {}
+
+
+def apply_runtime_env(env: dict | None) -> None:
+    """Worker side, before user code: set env vars; fetch/extract the
+    working_dir by digest (cached per process) and make it cwd + sys.path
+    head."""
+    if not env:
+        return
+    for k, v in (env.get("env_vars") or {}).items():
+        os.environ[k] = str(v)
+    digest = env.get("working_dir_uri")
+    if not digest:
+        return
+    target = _applied_dirs.get(digest)
+    if target is None:
+        from ray_tpu import api
+
+        client = api._ensure_client()
+        data = client.kv_get("runtime_env", f"pkg:{digest}".encode())
+        if data is None:
+            raise RuntimeError(f"working_dir package {digest} not in GCS")
+        base = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+        target = os.path.join(base, "runtime_envs", digest)
+        if not os.path.isdir(target):
+            tmp = f"{target}.{os.getpid()}.tmp"
+            with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, target)
+            except OSError:  # another worker won the race
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        _applied_dirs[digest] = target
+    os.chdir(target)
+    if target not in sys.path:
+        sys.path.insert(0, target)

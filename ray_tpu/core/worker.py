@@ -214,6 +214,9 @@ class Worker:
     def _run_normal_task(self, spec: TaskSpec):
         self.current_task_id = spec.task_id
         try:
+            from ray_tpu.core.runtime_env import apply_runtime_env
+
+            apply_runtime_env(spec.runtime_env)
             fn = serialization.unpack(spec.fn_blob)
             args, kwargs = self._resolve_args(spec)
             out = fn(*args, **kwargs)
@@ -226,6 +229,9 @@ class Worker:
 
     def _run_actor_creation(self, spec: TaskSpec):
         try:
+            from ray_tpu.core.runtime_env import apply_runtime_env
+
+            apply_runtime_env(spec.runtime_env)
             cls = serialization.unpack(spec.fn_blob)
             args, kwargs = self._resolve_args(spec)
             instance = cls(*args, **kwargs)

@@ -1,0 +1,83 @@
+"""Runtime environments: env_vars + working_dir shipping."""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestEnvVars:
+    def test_task_sees_env_vars(self, cluster):
+        @ray_tpu.remote
+        def read_env():
+            import os
+
+            return os.environ.get("MY_FLAG")
+
+        out = ray_tpu.get(read_env.options(
+            runtime_env={"env_vars": {"MY_FLAG": "on"}}).remote(), timeout=60)
+        assert out == "on"
+
+    def test_actor_sees_env_vars(self, cluster):
+        @ray_tpu.remote
+        class E:
+            def read(self):
+                import os
+
+                return os.environ.get("ACTOR_FLAG")
+
+        a = E.options(
+            runtime_env={"env_vars": {"ACTOR_FLAG": "42"}}).remote()
+        assert ray_tpu.get(a.read.remote(), timeout=60) == "42"
+        ray_tpu.kill(a)
+
+
+class TestWorkingDir:
+    def test_working_dir_shipped_and_importable(self, cluster, tmp_path):
+        pkg = tmp_path / "proj"
+        pkg.mkdir()
+        (pkg / "mymod.py").write_text("MAGIC = 'shipped-code'\n")
+        (pkg / "data.txt").write_text("payload\n")
+
+        @ray_tpu.remote
+        def use_module():
+            import mymod  # only importable via the shipped working_dir
+
+            return mymod.MAGIC, open("data.txt").read().strip()
+
+        out = ray_tpu.get(use_module.options(
+            runtime_env={"working_dir": str(pkg)}).remote(), timeout=60)
+        assert out == ("shipped-code", "payload")
+
+    def test_package_cached_by_digest(self, cluster, tmp_path):
+        from ray_tpu import api
+        from ray_tpu.core.runtime_env import resolve_runtime_env
+
+        pkg = tmp_path / "p2"
+        pkg.mkdir()
+        (pkg / "f.txt").write_text("x")
+        client = api._ensure_client()
+        env1 = resolve_runtime_env({"working_dir": str(pkg)}, client)
+        env2 = resolve_runtime_env({"working_dir": str(pkg)}, client)
+        assert env1["working_dir_uri"] == env2["working_dir_uri"]
+        assert client.kv_get(
+            "runtime_env", f"pkg:{env1['working_dir_uri']}".encode())
+
+    def test_oversize_working_dir_rejected(self, cluster, tmp_path,
+                                           monkeypatch):
+        from ray_tpu.core import runtime_env as re_mod
+
+        monkeypatch.setattr(re_mod, "MAX_WORKING_DIR_BYTES", 10)
+        pkg = tmp_path / "big"
+        pkg.mkdir()
+        (pkg / "blob.bin").write_bytes(b"z" * 100)
+        with pytest.raises(ValueError, match="exceeds"):
+            re_mod.package_working_dir(str(pkg))
