@@ -9,7 +9,6 @@ actor compute the model loads (and compiles) once per actor, not per block.
 
 from __future__ import annotations
 
-import uuid
 from typing import Any, Callable, Type
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -39,6 +38,17 @@ class BatchPredictor:
         self.checkpoint = checkpoint
         self.predictor_cls = predictor_cls
         self.predictor_kwargs = predictor_kwargs
+        # Stable across predict() calls AND closure re-pickling, so every
+        # worker loads this (checkpoint, predictor) combination once.
+        import hashlib
+
+        import cloudpickle
+
+        self._cache_key = hashlib.sha256(cloudpickle.dumps(
+            (predictor_cls.__qualname__, sorted(predictor_kwargs.items()),
+             checkpoint._data if checkpoint._data is not None
+             else checkpoint._path)
+        )).hexdigest()[:32]
 
     @classmethod
     def from_checkpoint(cls, checkpoint: Checkpoint,
@@ -52,7 +62,7 @@ class BatchPredictor:
         ckpt = self.checkpoint
         predictor_cls = self.predictor_cls
         kwargs = self.predictor_kwargs
-        cache_key = uuid.uuid4().hex  # stable across closure re-pickling
+        cache_key = self._cache_key
 
         def infer(batch):
             from ray_tpu.air.batch_predictor import _PREDICTOR_CACHE

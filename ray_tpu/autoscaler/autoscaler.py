@@ -89,6 +89,12 @@ class StandardAutoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.gcs_address = gcs_address
         self._idle_since: dict[str, float] = {}
+        # Launched but not yet registered in the GCS view: their capacity is
+        # credited to bin-packing so each reconcile pass doesn't re-launch
+        # for the same unmet demand (ref: resource_demand_scheduler pending
+        # node accounting).
+        self._booting: dict[str, tuple[str, float]] = {}  # id → (type, t0)
+        self.boot_timeout_s = 300.0
 
     # ---- inputs ----
 
@@ -121,6 +127,17 @@ class StandardAutoscaler:
                   for s in n.get("pending_demand", [])]
         free = [dict(n.get("resources_available", {}))
                 for n in alive.values()]
+        # Booting nodes: drop ones now visible (or timed out), credit the
+        # rest as free capacity.
+        now0 = time.monotonic()
+        registered = {(n.get("labels") or {}).get("provider_node_id")
+                      for n in alive.values()}
+        for nid in list(self._booting):
+            tname, t0 = self._booting[nid]
+            if nid in registered or now0 - t0 > self.boot_timeout_s:
+                del self._booting[nid]
+        free += [dict(self.node_types[t].resources)
+                 for t, _ in self._booting.values()]
 
         # Ensure min_workers.
         counts: dict[str, int] = {}
@@ -130,7 +147,9 @@ class StandardAutoscaler:
         launched: list[str] = []
         for nt in self.node_types.values():
             while counts.get(nt.name, 0) < nt.min_workers:
-                launched.append(self.provider.create_node(nt))
+                nid = self.provider.create_node(nt)
+                launched.append(nid)
+                self._booting[nid] = (nt.name, now0)
                 counts[nt.name] = counts.get(nt.name, 0) + 1
 
         # Scale up for unmet demand.
@@ -139,7 +158,9 @@ class StandardAutoscaler:
         for type_name, n in plan.items():
             nt = self.node_types[type_name]
             for _ in range(n):
-                launched.append(self.provider.create_node(nt))
+                nid = self.provider.create_node(nt)
+                launched.append(nid)
+                self._booting[nid] = (type_name, now0)
                 counts[type_name] = counts.get(type_name, 0) + 1
 
         # Scale down idle provider nodes (fully free, no demand anywhere).
@@ -147,6 +168,10 @@ class StandardAutoscaler:
         now = time.monotonic()
         if not demand:
             idle_provider_nodes = self._find_idle(alive)
+            # A node that went busy restarts its idle clock from scratch.
+            for nid in list(self._idle_since):
+                if nid not in idle_provider_nodes:
+                    del self._idle_since[nid]
             for nid in idle_provider_nodes:
                 since = self._idle_since.setdefault(nid, now)
                 t = self.provider.node_type(nid)

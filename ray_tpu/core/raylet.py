@@ -434,6 +434,17 @@ class Raylet:
             info = await self.gcs.call("pg_get", {"pg_id": pg_id})
             if info is None:
                 return {"error": f"placement group {pg_id.hex()[:12]} not found"}
+            # Statically infeasible (no bundle anywhere is big enough):
+            # fail now instead of ping-ponging spillbacks between holders.
+            if not any(
+                (index < 0 or b["index"] == index)
+                and all(b["resources"].get(rk, 0) >= rv
+                        for rk, rv in resources.items())
+                for b in info["bundles"]
+            ):
+                return {"error":
+                        f"resources {resources} exceed every bundle in the "
+                        "placement group"}
             for b in info["bundles"]:
                 if index >= 0 and b["index"] != index:
                     continue

@@ -60,17 +60,43 @@ def resolve_runtime_env(env: dict | None, client) -> dict | None:
 _applied_dirs: dict[str, str] = {}
 
 
-def apply_runtime_env(env: dict | None) -> None:
+def apply_runtime_env(env: dict | None):
     """Worker side, before user code: set env vars; fetch/extract the
     working_dir by digest (cached per process) and make it cwd + sys.path
-    head."""
+    head.
+
+    Returns a restore() callable that undoes env vars / cwd / sys.path so a
+    pooled worker doesn't leak one task's environment into the next (the
+    reference instead dedicates workers per runtime env; restoring is the
+    single-pool equivalent). Actors never restore — the env is theirs for
+    life."""
     if not env:
-        return
+        return lambda: None
+    saved_env = {k: os.environ.get(k) for k in (env.get("env_vars") or {})}
+    saved_cwd = os.getcwd()
+    saved_path_entry: list[str] = []
+
+    def restore():
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        try:
+            os.chdir(saved_cwd)
+        except OSError:
+            pass
+        for entry in saved_path_entry:
+            try:
+                sys.path.remove(entry)
+            except ValueError:
+                pass
+
     for k, v in (env.get("env_vars") or {}).items():
         os.environ[k] = str(v)
     digest = env.get("working_dir_uri")
     if not digest:
-        return
+        return restore
     target = _applied_dirs.get(digest)
     if target is None:
         from ray_tpu import api
@@ -95,3 +121,5 @@ def apply_runtime_env(env: dict | None) -> None:
     os.chdir(target)
     if target not in sys.path:
         sys.path.insert(0, target)
+        saved_path_entry.append(target)
+    return restore

@@ -213,10 +213,11 @@ class Worker:
 
     def _run_normal_task(self, spec: TaskSpec):
         self.current_task_id = spec.task_id
+        restore = None
         try:
             from ray_tpu.core.runtime_env import apply_runtime_env
 
-            apply_runtime_env(spec.runtime_env)
+            restore = apply_runtime_env(spec.runtime_env)
             fn = serialization.unpack(spec.fn_blob)
             args, kwargs = self._resolve_args(spec)
             out = fn(*args, **kwargs)
@@ -225,6 +226,9 @@ class Worker:
             err = TaskError(type(e).__name__, str(e), traceback.format_exc())
             return [err] * max(1, spec.num_returns), err
         finally:
+            # Pooled worker: don't leak this task's env into the next.
+            if restore is not None:
+                restore()
             self.current_task_id = None
 
     def _run_actor_creation(self, spec: TaskSpec):

@@ -82,10 +82,11 @@ class LLMEngine:
     def submit(self, prompt_ids: list[int], *, max_tokens: int = 64,
                temperature: float = 0.0, eos_id: int | None = None,
                stream: bool = False) -> GenRequest:
-        if len(prompt_ids) >= min(self.max_len, self.buckets[-1]):
+        # Bucket bound is inclusive; max_len needs headroom for ≥1 token.
+        if len(prompt_ids) > self.buckets[-1] or len(prompt_ids) >= self.max_len:
             raise ValueError(
-                f"prompt too long: {len(prompt_ids)} ≥ "
-                f"{min(self.max_len, self.buckets[-1])}")
+                f"prompt too long: {len(prompt_ids)} (bucket cap "
+                f"{self.buckets[-1]}, cache cap {self.max_len - 1})")
         req = GenRequest(
             request_id=uuid.uuid4().hex[:12],
             prompt_ids=list(prompt_ids),

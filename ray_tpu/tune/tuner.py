@@ -257,6 +257,7 @@ class Tuner:
                 launch(t, t.last_checkpoint)
                 running.append(t)
             time.sleep(poll_interval)
+            dirty = False
             for t in list(running):
                 try:
                     p = ray_tpu.get(t.actor.poll.remote(), timeout=60)
@@ -270,6 +271,9 @@ class Tuner:
                         running.remove(t)
                     continue
                 decision = CONTINUE
+                if p["reports"] or p.get("checkpoint") is not None or \
+                        p["error"] or p["done"]:
+                    dirty = True
                 for r in p["reports"]:
                     t.iteration += 1
                     r.setdefault(tc.time_attr, t.iteration)
@@ -313,7 +317,8 @@ class Tuner:
                     self._stop_actor(t)
                     running.remove(t)
                     finish(t)
-            self._save_experiment(trials)
+            if dirty:  # avoid rewriting unchanged state every poll tick
+                self._save_experiment(trials)
         self._save_experiment(trials)
         return ResultGrid(trials, tc.metric, tc.mode)
 

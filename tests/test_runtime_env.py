@@ -26,6 +26,21 @@ class TestEnvVars:
             runtime_env={"env_vars": {"MY_FLAG": "on"}}).remote(), timeout=60)
         assert out == "on"
 
+    def test_env_does_not_leak_to_next_task(self, cluster):
+        """Pooled workers must restore env/cwd/sys.path between tasks."""
+        @ray_tpu.remote
+        def read_env():
+            import os
+
+            return os.environ.get("LEAKY")
+
+        out = ray_tpu.get(read_env.options(
+            runtime_env={"env_vars": {"LEAKY": "yes"}}).remote(), timeout=60)
+        assert out == "yes"
+        # Subsequent plain tasks (likely the same pooled worker) are clean.
+        outs = ray_tpu.get([read_env.remote() for _ in range(4)], timeout=60)
+        assert outs == [None] * 4
+
     def test_actor_sees_env_vars(self, cluster):
         @ray_tpu.remote
         class E:
