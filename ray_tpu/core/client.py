@@ -77,7 +77,8 @@ class CoreClient:
             target=self._loop.run_forever, name="ray_tpu-client", daemon=True
         )
         self._thread.start()
-        self.gcs: rpc.Connection = self._run(self._connect(gcs_address))
+        self.gcs: rpc.ReconnectingConnection = self._run(
+            self._connect_gcs(gcs_address))
         self.raylet: rpc.Connection = self._run(self._connect(raylet_address))
         if job_id is None:
             job_id = self._run(self.gcs.call("next_job_id", {}))
@@ -101,6 +102,20 @@ class CoreClient:
             timeout=self.config.rpc_connect_timeout_s,
             notify_handler=self._notify,
         )
+
+    async def _connect_gcs(self, addr) -> rpc.ReconnectingConnection:
+        async def on_reconnect(conn):
+            await conn.call("subscribe", {"channels": ["actor"]})
+
+        conn = rpc.ReconnectingConnection(
+            *addr,
+            dial_timeout=self.config.rpc_connect_timeout_s,
+            reconnect_window_s=self.config.gcs_reconnect_window_s,
+            notify_handler=self._notify,
+            on_reconnect=on_reconnect,
+        )
+        await conn._ensure()
+        return conn
 
     def _notify(self, method: str, payload: Any) -> None:
         if method == "pub:actor":

@@ -67,6 +67,10 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_max_frame_bytes: int = 512 * 1024**2
+    # GCS failover: how long raylets/clients keep retrying through a GCS
+    # restart (ref: ray_config_def.h:70
+    # gcs_failover_worker_reconnect_timeout).
+    gcs_reconnect_window_s: float = 60.0
 
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"

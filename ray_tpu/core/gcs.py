@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -59,8 +60,10 @@ class ActorInfo:
 
 
 class GcsServer:
-    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, config: Config, host: str = "127.0.0.1", port: int = 0,
+                 snapshot_path: str | None = None):
         self.config = config
+        self.snapshot_path = snapshot_path
         self.server = rpc.Server(host, port)
         self.nodes: dict[bytes, NodeInfo] = {}
         self.actors: dict[bytes, ActorInfo] = {}
@@ -135,6 +138,10 @@ class GcsServer:
         )
         self.nodes[node_id] = info
         self._node_conns[node_id] = conn
+        # Re-registration after GCS failover: the raylet re-announces the
+        # objects it still holds so the object directory heals.
+        for ob in p.get("objects", ()):
+            self.object_dir.setdefault(ob, set()).add(node_id)
         logger.info("node %s registered at %s", node_id.hex()[:8], info.address)
         self.publish("node", {"event": "added", "node_id": node_id,
                               "address": info.address,
@@ -592,13 +599,85 @@ class GcsServer:
                     self._mark_node_dead(nid, "heartbeat timeout")
 
     async def start(self) -> tuple[str, int]:
+        self._restore_snapshot()
         addr = await self.server.start()
         asyncio.ensure_future(self._health_loop())
+        if self.snapshot_path:
+            asyncio.ensure_future(self._snapshot_loop())
         logger.info("GCS listening on %s", addr)
         return addr
 
     async def stop(self) -> None:
         await self.server.stop()
+
+    # ---------- fault tolerance: durable state ----------
+    # (ref: gcs/store_client/redis_store_client.h — Redis-backed tables
+    #  reloaded via gcs_init_data.cc on restart; here a pickle snapshot
+    #  plays Redis' role and raylets/clients reconnect + re-register.)
+
+    def _snapshot_state(self) -> dict:
+        import dataclasses
+
+        return {
+            "nodes": [dataclasses.asdict(n) for n in self.nodes.values()],
+            "actors": [dataclasses.asdict(a) for a in self.actors.values()],
+            "named_actors": dict(self.named_actors),
+            "kv": {ns: dict(d) for ns, d in self.kv.items()},
+            "placement_groups": dict(self.placement_groups),
+            "object_dir": {k: set(v) for k, v in self.object_dir.items()},
+            "job_counter": self._job_counter,
+        }
+
+    async def _snapshot_loop(self) -> None:
+        import pickle
+
+        last = None
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                state = self._snapshot_state()
+                blob = pickle.dumps(state)
+                if blob == last:
+                    continue
+                last = blob
+                tmp = f"{self.snapshot_path}.tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, self.snapshot_path)
+            except Exception:
+                logger.exception("snapshot failed")
+
+    def _restore_snapshot(self) -> None:
+        import pickle
+
+        if not self.snapshot_path or not os.path.exists(self.snapshot_path):
+            return
+        with open(self.snapshot_path, "rb") as f:
+            state = pickle.load(f)
+        now = time.monotonic()
+        for nd in state["nodes"]:
+            nd["address"] = tuple(nd["address"])
+            n = NodeInfo(**nd)
+            # Give every restored node a fresh heartbeat window to
+            # reconnect before being declared dead.
+            n.last_heartbeat = now
+            self.nodes[n.node_id] = n
+        for ad in state["actors"]:
+            if ad["address"] is not None:
+                ad["address"] = tuple(ad["address"])
+            if ad.get("owner_address") is not None:
+                ad["owner_address"] = tuple(ad["owner_address"])
+            a = ActorInfo(**ad)
+            a.placing = False  # the placing client may be gone
+            self.actors[a.actor_id] = a
+        self.named_actors = state["named_actors"]
+        self.kv = state["kv"]
+        self.placement_groups = state["placement_groups"]
+        self.object_dir = state["object_dir"]
+        self._job_counter = state["job_counter"]
+        logger.info(
+            "restored snapshot: %d nodes, %d actors, %d kv namespaces",
+            len(self.nodes), len(self.actors), len(self.kv))
 
 
 def main() -> None:
@@ -607,13 +686,16 @@ def main() -> None:
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--config", default=None)
     ap.add_argument("--ready-fd", type=int, default=None)
+    ap.add_argument("--snapshot-path", default=None,
+                    help="durable state file (enables restart recovery)")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="[gcs] %(levelname)s %(message)s")
     config = Config.from_json(open(args.config).read()) if args.config else Config.from_env()
 
     async def run():
-        gcs = GcsServer(config, args.host, args.port)
+        gcs = GcsServer(config, args.host, args.port,
+                        snapshot_path=args.snapshot_path)
         host, port = await gcs.start()
         if args.ready_fd is not None:
             import os

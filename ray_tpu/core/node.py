@@ -79,7 +79,9 @@ class Node:
         if self.head:
             gcs_proc, self.gcs_address = _spawn_with_ready_fd(
                 [sys.executable, "-m", "ray_tpu.core.gcs",
-                 "--config", self._config_path],
+                 "--config", self._config_path,
+                 "--snapshot-path",
+                 os.path.join(self.session_dir, "gcs_snapshot.pkl")],
                 os.path.join(logs, "gcs.log"),
             )
             self.procs.append(gcs_proc)
@@ -94,6 +96,25 @@ class Node:
             os.path.join(logs, "raylet.log"),
         )
         self.procs.append(raylet_proc)
+
+    def restart_gcs(self) -> None:
+        """Kill and restart the GCS at the same port with its snapshot —
+        the fault-injection hook for GCS failover tests
+        (ref: tests/test_gcs_fault_tolerance.py)."""
+        assert self.head and self.procs, "not a running head node"
+        gcs_proc = self.procs[0]
+        gcs_proc.kill()
+        gcs_proc.wait(timeout=10)
+        logs = os.path.join(self.session_dir, "logs")
+        new_proc, self.gcs_address = _spawn_with_ready_fd(
+            [sys.executable, "-m", "ray_tpu.core.gcs",
+             "--config", self._config_path,
+             "--port", str(self.gcs_address[1]),
+             "--snapshot-path",
+             os.path.join(self.session_dir, "gcs_snapshot.pkl")],
+            os.path.join(logs, "gcs.log"),
+        )
+        self.procs[0] = new_proc
 
     def stop(self) -> None:
         for p in reversed(self.procs):

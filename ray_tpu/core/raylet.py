@@ -139,18 +139,24 @@ class Raylet:
                 raise rpc.RpcError(f"unknown method {method!r}")
             return await fn(self.gcs, payload)
 
-        self.gcs = await rpc.connect(
+        async def _on_gcs_reconnect(conn):
+            # GCS failover: re-register with held objects, re-subscribe,
+            # refresh the view (ref: node_manager.proto:355
+            # NotifyGCSRestart semantics, initiated from our side).
+            await conn.call("register_node", self._register_payload())
+            await conn.call("subscribe", {"channels": ["node"]})
+            self.cluster_view = await conn.call("get_cluster_view", {})
+            logger.info("re-registered with restarted GCS")
+
+        self.gcs = rpc.ReconnectingConnection(
             *self.gcs_address,
-            timeout=self.config.rpc_connect_timeout_s,
+            dial_timeout=self.config.rpc_connect_timeout_s,
+            reconnect_window_s=self.config.gcs_reconnect_window_s,
             notify_handler=self._gcs_notify,
             request_handler=_gcs_request,
+            on_reconnect=_on_gcs_reconnect,
         )
-        await self.gcs.call("register_node", {
-            "node_id": self.node_id,
-            "address": addr,
-            "resources": self.resources_total,
-            "labels": self.labels,
-        })
+        await self.gcs.call("register_node", self._register_payload())
         await self.gcs.call("subscribe", {"channels": ["node"]})
         view = await self.gcs.call("get_cluster_view", {})
         self.cluster_view = view
@@ -163,6 +169,16 @@ class Raylet:
             NodeID(self.node_id).hex()[:8], addr, self.resources_total,
         )
         return addr
+
+    def _register_payload(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "address": self.address,
+            "resources": self.resources_total,
+            "labels": self.labels,
+            "objects": [oid.binary() for oid, e in self.store.entries.items()
+                        if e.sealed and not e.doomed],
+        }
 
     def _gcs_notify(self, method: str, payload: Any) -> None:
         if method == "pub:node":
@@ -199,12 +215,8 @@ class Raylet:
                     ],
                 }, timeout=5.0)
                 if resp.get("reregister"):
-                    await self.gcs.call("register_node", {
-                        "node_id": self.node_id,
-                        "address": self.address,
-                        "resources": self.resources_total,
-                        "labels": self.labels,
-                    })
+                    await self.gcs.call("register_node",
+                                        self._register_payload())
                 # refresh cluster view opportunistically
                 self.cluster_view = await self.gcs.call("get_cluster_view", {})
             except (rpc.ConnectionLost, asyncio.TimeoutError):

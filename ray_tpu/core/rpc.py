@@ -229,6 +229,78 @@ class Server:
             await conn.close()
 
 
+class ReconnectingConnection:
+    """Connection facade that redials on loss (GCS failover support).
+
+    Parity: the reference's GCS clients reconnect within
+    `gcs_failover_worker_reconnect_timeout` (`ray_config_def.h:70`,
+    `gcs_client_reconnection_test.cc`). `call()` retries across redials
+    until `reconnect_window_s` elapses; `on_reconnect` runs after each
+    successful redial (re-register, re-subscribe, re-announce)."""
+
+    def __init__(self, host: str, port: int, *,
+                 dial_timeout: float = 10.0,
+                 reconnect_window_s: float = 60.0,
+                 notify_handler=None, request_handler=None,
+                 on_reconnect=None):
+        self.addr = (host, port)
+        self.dial_timeout = dial_timeout
+        self.reconnect_window_s = reconnect_window_s
+        self._notify_handler = notify_handler
+        self._request_handler = request_handler
+        self._on_reconnect = on_reconnect
+        self._conn: Connection | None = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+        self._ever_connected = False
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def _ensure(self) -> Connection:
+        if self._conn is not None and not self._conn.closed:
+            return self._conn
+        async with self._lock:
+            if self._conn is not None and not self._conn.closed:
+                return self._conn
+            if self._closed:
+                raise ConnectionLost("connection explicitly closed")
+            conn = await connect(
+                *self.addr, timeout=self.dial_timeout,
+                notify_handler=self._notify_handler,
+                request_handler=self._request_handler,
+            )
+            self._conn = conn
+            if self._ever_connected and self._on_reconnect is not None:
+                await self._on_reconnect(conn)
+            self._ever_connected = True
+            return conn
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: float | None = None) -> Any:
+        deadline = (asyncio.get_running_loop().time()
+                    + self.reconnect_window_s)
+        while True:
+            try:
+                conn = await self._ensure()
+                return await conn.call(method, payload, timeout=timeout)
+            except ConnectionLost:
+                if (self._closed
+                        or asyncio.get_running_loop().time() > deadline):
+                    raise
+                await asyncio.sleep(0.2)
+
+    def notify(self, method: str, payload: Any = None) -> None:
+        if self._conn is not None and not self._conn.closed:
+            self._conn.notify(method, payload)
+
+    async def close(self):
+        self._closed = True
+        if self._conn is not None:
+            await self._conn.close()
+
+
 async def connect(
     host: str,
     port: int,

@@ -84,9 +84,12 @@ class Worker:
             timeout=self.config.rpc_connect_timeout_s,
             notify_handler=self._raylet_notify,
         )
-        self.gcs = await rpc.connect(
-            *self.gcs_address, timeout=self.config.rpc_connect_timeout_s
+        self.gcs = rpc.ReconnectingConnection(
+            *self.gcs_address,
+            dial_timeout=self.config.rpc_connect_timeout_s,
+            reconnect_window_s=self.config.gcs_reconnect_window_s,
         )
+        await self.gcs._ensure()
         await self.raylet.call("register_worker", {
             "worker_id": self.worker_id,
             "address": self.address,

@@ -1,0 +1,75 @@
+"""GCS fault tolerance: kill + restart the control plane; the cluster
+heals. Mirrors `/root/reference/python/ray/tests/test_gcs_fault_tolerance.
+py` + `gcs_client_reconnection_test.cc` behaviors."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture()
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _restart_gcs():
+    from ray_tpu import api
+
+    api._node.restart_gcs()
+
+
+class TestGcsFailover:
+    def test_tasks_survive_gcs_restart(self, cluster):
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+        _restart_gcs()
+        # New work flows as soon as everyone reconnects.
+        out = ray_tpu.get([add.remote(i, i) for i in range(5)], timeout=120)
+        assert out == [0, 2, 4, 6, 8]
+
+    def test_actor_and_kv_state_survive(self, cluster):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="ft_counter").remote()
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+        from ray_tpu import api
+
+        client = api._ensure_client()
+        client.kv_put("userspace", b"k1", b"v1")
+        time.sleep(1.5)  # let the snapshot loop persist the state
+        _restart_gcs()
+        # Actor directory recovered: the named handle still resolves and
+        # the actor (which never died) kept its in-memory state.
+        c2 = ray_tpu.get_actor("ft_counter")
+        assert ray_tpu.get(c2.incr.remote(), timeout=120) == 2
+        assert client.kv_get("userspace", b"k1") == b"v1"
+
+    def test_objects_resolvable_after_restart(self, cluster):
+        big = np.arange(200_000, dtype=np.float64)
+        ref = ray_tpu.put(big)
+        time.sleep(1.5)
+        _restart_gcs()
+
+        @ray_tpu.remote
+        def total(x):
+            return float(x.sum())
+
+        # The object directory healed (snapshot + re-announce), so a task
+        # can still consume the pre-restart object.
+        out = ray_tpu.get(total.remote(ref), timeout=120)
+        assert out == float(big.sum())
