@@ -1,0 +1,49 @@
+"""Force the virtual host-CPU backend before first JAX backend touch.
+
+Single source of truth for the "axon sitecustomize pins jax_platforms to
+'axon,cpu'" workaround, shared by tests/conftest.py, __graft_entry__.py and
+bench.py: the JAX_PLATFORMS env var alone is NOT enough (the sitecustomize
+overrides it), so the jax config must be updated directly — and XLA_FLAGS
+must carry the host device count before the CPU backend is created.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n_devices: int = 8) -> None:
+    """Pin jax to the CPU platform with >= n_devices virtual devices.
+
+    Must be called before the first backend touch (jax import is fine).
+    Idempotent; raises if an earlier XLA_FLAGS pinned a smaller count after
+    the backend already exists (nothing can be done then).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"--{_FLAG}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --{_FLAG}={n_devices}"
+        ).strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = re.sub(
+            rf"--{_FLAG}=\d+", f"--{_FLAG}={n_devices}", flags
+        )
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend may already be initialized; verified below
+    # Loudly verify the pin took — config.update silently loses the race if
+    # the backend was already created (e.g. entry() ran first), and a "CPU
+    # dry-run" silently executing on real hardware must never happen.
+    platform = jax.devices()[0].platform
+    if platform != "cpu":
+        raise RuntimeError(
+            f"force_cpu_devices: backend already initialized on {platform!r}; "
+            "call before any jax backend touch"
+        )

@@ -5,23 +5,15 @@ Mirrors the reference's multi-node-without-a-cluster trick
 here, N XLA host devices on one process stand in for N TPU chips so every
 sharding/collective path is exercised without a pod.
 
-Must run before any backend is initialized: XLA_FLAGS is read at backend
-creation, and the axon sitecustomize pins jax_platforms to "axon,cpu", so we
-override the config directly rather than via JAX_PLATFORMS.
+Platform forcing lives in ray_tpu.utils.platform (shared with bench.py and
+__graft_entry__.py) — it must run before any backend is initialized.
 """
 
-import os
+from ray_tpu.utils.platform import force_cpu_devices
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_cpu_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 import pytest  # noqa: E402
 
 
