@@ -26,6 +26,14 @@ _node = None
 _lock = threading.RLock()
 
 
+def _current_counter():
+    """The live client's ReferenceCounter, or None pre-init/post-shutdown."""
+    c = _client
+    if c is None or c._closed:
+        return None
+    return c.refcounter
+
+
 class RayTaskError(Exception):
     """A task/actor method raised; carries the remote traceback."""
 
@@ -39,13 +47,21 @@ class ObjectRef:
     """Future-like handle to an object in the cluster.
 
     Pickles by identity (ref: `_private/serialization.py:110-131`) so refs can
-    be captured in closures and passed into tasks.
+    be captured in closures and passed into tasks. Every live instance holds
+    one local reference in the process's ReferenceCounter (ref:
+    `reference_count.h:61` local_ref_count); `__del__` releases it, and
+    process-level zero triggers a batched release to the GCS → automatic
+    object GC.
     """
 
-    __slots__ = ("id",)
+    __slots__ = ("id", "_counter", "__weakref__")
 
     def __init__(self, object_id: ObjectID):
         self.id = object_id
+        c = _current_counter()
+        self._counter = c
+        if c is not None:
+            c.incref(object_id.binary())
 
     def hex(self) -> str:
         return self.id.hex()
@@ -60,7 +76,22 @@ class ObjectRef:
         return isinstance(other, ObjectRef) and other.id == self.id
 
     def __reduce__(self):
+        # Escaping via serialization: report to the active capture scope so
+        # the sender can escrow the ref while it is in flight (borrowed-ref
+        # registration, ref: reference_count.h:511).
+        serialization.note_ref(self.id.binary())
         return (ObjectRef, (self.id,))
+
+    def __del__(self):
+        # May run inside the cyclic GC on ANY thread — including while that
+        # thread holds the counter's or the lineage lock. Only a lock-free
+        # deque append happens here; the flusher drains it.
+        c = self._counter
+        if c is not None:
+            try:
+                c.decref_deferred(self.id.binary())
+            except Exception:
+                pass
 
     def future(self):
         import concurrent.futures
@@ -222,11 +253,18 @@ class RemoteFunction:
         self._fn = fn
         self._options = options
         self._fn_blob: bytes | None = None
+        self._captured_refs: list = []
         functools.update_wrapper(self, fn)
 
     def _blob(self) -> bytes:
         if self._fn_blob is None:
-            self._fn_blob = serialization.pack(self._fn)
+            # ObjectRefs captured in the function body (globals/closures) are
+            # snapshotted into the pickle — hold live refs alongside the
+            # cached blob so the objects can't be GC'd while the function
+            # remains callable (borrowed-ref parity for captures).
+            with serialization.capture_refs() as caps:
+                self._fn_blob = serialization.pack(self._fn)
+            self._captured_refs = [ObjectRef(ObjectID(o)) for o in caps]
         return self._fn_blob
 
     def options(self, **opts) -> "RemoteFunction":
@@ -323,6 +361,15 @@ class ActorClass:
         _validate_options(options, for_actor=True)
         self._cls = cls
         self._options = options
+        self._cls_blob: bytes | None = None
+        self._captured_refs: list = []
+
+    def _blob(self) -> bytes:
+        if self._cls_blob is None:
+            with serialization.capture_refs() as caps:
+                self._cls_blob = serialization.pack(self._cls)
+            self._captured_refs = [ObjectRef(ObjectID(o)) for o in caps]
+        return self._cls_blob
 
     def options(self, **opts) -> "ActorClass":
         return ActorClass(self._cls, {**self._options, **opts})
@@ -338,7 +385,7 @@ class ActorClass:
         if o.get("num_cpus") is None and "CPU" not in (o.get("resources") or {}):
             hold["CPU"] = 0.0
         actor_id = client.create_actor(
-            serialization.pack(self._cls),
+            self._blob(),
             self._cls.__name__,
             args, kwargs,
             resources=placement,

@@ -18,6 +18,7 @@ import logging
 import os
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Sequence
 
 from ray_tpu.core import rpc, serialization
@@ -59,6 +60,9 @@ class ActorState:
         self.ready = asyncio.Event()   # set when ALIVE (or DEAD — check .dead)
         self.restarting = False
         self._restart_driver = None
+        # Refs riding the creation spec: held until the actor is DEAD (the
+        # spec is replayed on restart, so its args must stay resolvable).
+        self.creation_escrow: list[bytes] = []
 
 
 class CoreClient:
@@ -91,8 +95,35 @@ class CoreClient:
         self._worker_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._raylet_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._result_events: dict[bytes, threading.Event] = {}
+        # Lineage (ref: object_recovery_manager.h:41, task_manager.h:86
+        # lineage pinning): return id → the TaskSpec that creates it, kept
+        # while this process holds a reference, so lost objects can be
+        # rebuilt by re-executing their creating task (transitively).
+        self._lineage: dict[bytes, TaskSpec] = {}
+        self._lineage_lock = threading.Lock()
+        self._lineage_budget: dict[bytes, int] = {}      # task_id → retries
+        # oid → number of pinned specs consuming it as an argument: keeps an
+        # upstream object's lineage alive while downstream lineage needs it
+        # (ref: reference_count.h lineage refs).
+        self._lineage_deps: dict[bytes, int] = {}
+        self._recoveries: dict[bytes, asyncio.Future] = {}  # task_id → done
         self._closed = False
+        # Distributed ref counting (ref: reference_count.h:61): exact local
+        # counts here, batched process-level holds to the GCS.
+        from ray_tpu.core.refcount import ReferenceCounter
+
+        self.refcounter = ReferenceCounter(self)
         self._run(self.gcs.call("subscribe", {"channels": ["actor"]}))
+        if self.config.ref_counting_enabled:
+            self._run(self.gcs.call("ref_register_holder", {
+                "holder_id": self.refcounter.holder_id, "held": [],
+            }))
+            self._run(self._start_ref_flusher())
+        else:
+            self.refcounter._closed = True
+
+    async def _start_ref_flusher(self):
+        self.refcounter.start(self.config.ref_flush_interval_s)
 
     # ------------------------------------------------------------ plumbing
 
@@ -106,6 +137,11 @@ class CoreClient:
     async def _connect_gcs(self, addr) -> rpc.ReconnectingConnection:
         async def on_reconnect(conn):
             await conn.call("subscribe", {"channels": ["actor"]})
+            # GCS failover: ref tables are runtime state, rebuilt by holders
+            # re-announcing everything — holds, owned ids, containment.
+            if self.config.ref_counting_enabled and hasattr(self, "refcounter"):
+                await conn.call("ref_register_holder",
+                                self.refcounter.registration_payload())
 
         conn = rpc.ReconnectingConnection(
             *addr,
@@ -118,6 +154,20 @@ class CoreClient:
         return conn
 
     def _notify(self, method: str, payload: Any) -> None:
+        if method == "objects_freed":
+            # The GCS freed these owned objects cluster-wide: no holder
+            # remains anywhere, so their lineage pins can finally drop.
+            for oid in payload["object_ids"]:
+                self.refcounter.forget_contains(oid)
+                self._maybe_evict_lineage(oid)
+            return
+        if method == "recover_objects":
+            # A borrower somewhere failed to pull an object we own: rebuild
+            # it (lineage re-execution or owner re-put).
+            if self.config.lineage_reconstruction_enabled and not self._closed:
+                asyncio.ensure_future(
+                    self._recover_missing(payload["object_ids"]))
+            return
         if method == "pub:actor":
             st = self._actors.get(payload["actor_id"])
             if st is None:
@@ -135,6 +185,7 @@ class CoreClient:
             elif state == "DEAD":
                 st.dead = True
                 st.death_cause = payload.get("cause")
+                self._release_creation_escrow(st)
                 st.ready.set()
 
     def _run(self, coro, timeout=None):
@@ -145,6 +196,7 @@ class CoreClient:
         if self._closed:
             return
         self._closed = True
+        self.refcounter.close()
         for mv in self._mmaps.values():
             try:
                 mv.release()
@@ -179,28 +231,78 @@ class CoreClient:
 
     # ------------------------------------------------------------ objects
 
+    def _on_local_release(self, oid: bytes) -> None:
+        """This process's last ObjectRef to `oid` died: evict the value cache,
+        release the zero-copy view, and drop the raylet-side reader pin.
+        Called from arbitrary threads (GC); must not block."""
+        self._memory_store.pop(oid, None)
+        self._result_events.pop(oid, None)
+        # NOTE: lineage is NOT evicted here — remote borrowers may still
+        # hold the object (only this process's refs died). Lineage drops
+        # when the GCS frees the object cluster-wide ("objects_freed").
+        if oid in self._mmaps:
+            if not self._try_release_mmap(oid):
+                # A live value still exports the buffer (zero-copy numpy view)
+                # — retried on the flusher tick until the value dies.
+                self.refcounter.defer_local(oid)
+
+    def _try_release_mmap(self, oid: bytes) -> bool:
+        mv = self._mmaps.get(oid)
+        if mv is None:
+            return True
+        try:
+            mv.release()
+        except BufferError:
+            return False
+        self._mmaps.pop(oid, None)
+        if not self._closed:
+            # Fire-and-forget unpin so the store may spill/evict the extent.
+            async def _unpin():
+                try:
+                    await self.raylet.call(
+                        "store_release", {"object_ids": [oid]}, timeout=10)
+                except Exception:
+                    pass
+
+            try:
+                asyncio.run_coroutine_threadsafe(_unpin(), self._loop)
+            except RuntimeError:
+                pass
+        return True
+
     def put(self, value: Any):
         from ray_tpu.api import ObjectRef
 
         obj = ObjectID.from_put(self.task_id_root, next(self._put_counter))
-        head, views = serialization.serialize(value)
+        self.refcounter.mark_owned(obj.binary())
+        with serialization.capture_refs() as nested:
+            head, views = serialization.serialize(value)
+        if nested:
+            # refs-in-refs (ref: reference_count.h:534): the stored object
+            # keeps its inner refs alive until it is itself freed.
+            self.refcounter.add_contains(obj.binary(), nested)
+        self._run(self._store_serialized(obj.binary(), head, views))
+        self._memory_store[obj.binary()] = value
+        return ObjectRef(obj)
+
+    async def _store_serialized(self, oid: bytes, head: bytes, views) -> None:
+        """Write a serialized value into the node store under `oid`:
+        inline below the cutoff, zero-copy extent write + seal above."""
         size = serialization.serialized_size(head, views)
         if size <= self.config.max_inline_object_size:
             data = bytearray(size)
             serialization.write_to(memoryview(data), head, views)
-            self._run(self.raylet.call("store_put_inline", {
-                "object_id": obj.binary(), "data": bytes(data),
-            }))
+            await self.raylet.call("store_put_inline", {
+                "object_id": oid, "data": bytes(data),
+            })
         else:
-            resp = self._run(self.raylet.call("store_create", {
-                "object_id": obj.binary(), "size": size,
-            }))
+            resp = await self.raylet.call("store_create", {
+                "object_id": oid, "size": size,
+            })
             view = attach_extent(resp["arena"], resp["offset"], size)
             serialization.write_to(view, head, views)
             view.release()
-            self._run(self.raylet.call("store_seal", {"object_id": obj.binary()}))
-        self._memory_store[obj.binary()] = value
-        return ObjectRef(obj)
+            await self.raylet.call("store_seal", {"object_id": oid})
 
     def get(self, refs: Sequence, timeout: float | None = None) -> list:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -224,16 +326,24 @@ class CoreClient:
                 out[i] = self._memory_store[key]
             else:
                 missing.append((i, key))
-        if missing:
+        # Bounded store_get rounds: each probe window the client re-checks
+        # cluster liveness of still-missing objects and triggers lineage
+        # reconstruction for owned lost ones (ref: object_recovery_manager.h
+        # RecoverObject on pull failure), so a node death mid-get heals.
+        probe = self.config.get_probe_interval_s
+        while missing:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            chunk = probe if remaining is None else min(probe, remaining)
             resolved = self._run(self.raylet.call("store_get", {
                 "object_ids": [k for _, k in missing],
-                "timeout": timeout,
-            }), timeout=None if timeout is None else timeout + 10)
+                "timeout": chunk,
+            }), timeout=chunk + 30)
+            still: list[tuple[int, bytes]] = []
             for (i, key), (loc, data) in zip(missing, resolved):
                 if loc == "missing":
-                    raise GetTimeoutError(
-                        f"object {key.hex()[:16]} not available within timeout"
-                    )
+                    still.append((i, key))
+                    continue
                 if loc == "inline":
                     value = serialization.unpack(data)
                 else:
@@ -243,6 +353,29 @@ class CoreClient:
                     value = serialization.unpack(view)
                 self._memory_store[key] = value
                 out[i] = value
+            missing = still
+            if not missing:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(
+                    f"object {missing[0][1].hex()[:16]} not available "
+                    "within timeout"
+                )
+            if self.config.lineage_reconstruction_enabled:
+                # Bound recovery by the caller's remaining deadline so a
+                # get(timeout=X) cannot block through a slow re-execution.
+                rem = (None if deadline is None
+                       else max(0.1, deadline - time.monotonic()))
+                try:
+                    self._run(
+                        self._recover_missing([k for _, k in missing]),
+                        timeout=rem,
+                    )
+                except FuturesTimeoutError:
+                    raise GetTimeoutError(
+                        f"object {missing[0][1].hex()[:16]} lost; "
+                        "reconstruction exceeded the get() timeout"
+                    )
         for i, ref in enumerate(refs):
             if isinstance(out[i], _TaskErrorSentinel):
                 raise out[i].err.to_exception()
@@ -251,6 +384,129 @@ class CoreClient:
             if isinstance(out[i], TaskError):
                 raise out[i].to_exception()
         return out
+
+    # ------------------------------------------------ lineage reconstruction
+    # (ref: core_worker/object_recovery_manager.h:41,90 + task_manager.h:86
+    #  lineage pinning — owner-scoped: each client can rebuild the objects
+    #  whose creating tasks it submitted, transitively through arguments)
+
+    def _maybe_evict_lineage(self, oid: bytes) -> None:
+        """Drop a lineage pin once neither this process (refs) nor any
+        pinned downstream spec (deps) needs the object; cascades upstream.
+        Callers come from GC threads, submitter threads, and the loop — all
+        mutations go through _lineage_lock."""
+        with self._lineage_lock:
+            self._evict_lineage_locked(oid)
+
+    def _evict_lineage_locked(self, oid: bytes) -> None:
+        if self.refcounter.count(oid) > 0:
+            return
+        if self._lineage_deps.get(oid, 0) > 0:
+            return
+        spec = self._lineage.pop(oid, None)
+        if spec is None:
+            return
+        if any(rid in self._lineage for rid in spec.return_ids):
+            return  # sibling returns still pin the spec
+        self._lineage_budget.pop(spec.task_id, None)
+        for a in spec.args:
+            if a.kind != "ref":
+                continue
+            n = self._lineage_deps.get(a.object_id, 0) - 1
+            if n <= 0:
+                self._lineage_deps.pop(a.object_id, None)
+                self._evict_lineage_locked(a.object_id)
+            else:
+                self._lineage_deps[a.object_id] = n
+
+    async def _recover_missing(self, oids: list[bytes]) -> None:
+        await asyncio.gather(
+            *(self._recover_object(oid) for oid in oids),
+            return_exceptions=True,
+        )
+
+    async def _recover_object(self, oid: bytes) -> bool:
+        spec = self._lineage.get(oid)
+        if spec is None:
+            # put() objects: the owner still holds the value — re-store it
+            # (the reference instead fails puts; owning the value lets us
+            # do strictly better here).
+            if oid in self._memory_store:
+                return await self._re_put(oid)
+            return False
+        tkey = spec.task_id
+        fut = self._recoveries.get(tkey)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        if any(rid in self._result_events for rid in spec.return_ids):
+            # The creating task (first execution or an earlier recovery) is
+            # still in flight — a borrower's pull of the not-yet-sealed
+            # output must wait, not duplicate the execution.
+            return False
+        fut = asyncio.get_running_loop().create_future()
+        self._recoveries[tkey] = fut
+        try:
+            ok = await self._recover_task(spec)
+        except Exception as e:
+            logger.warning("recovery of %s failed: %s", spec.name, e)
+            ok = False
+        finally:
+            self._recoveries.pop(tkey, None)
+        fut.set_result(ok)
+        return ok
+
+    async def _recover_task(self, spec: TaskSpec) -> bool:
+        with self._lineage_lock:
+            budget = self._lineage_budget.get(spec.task_id, 0)
+            if budget <= 0:
+                return False
+            self._lineage_budget[spec.task_id] = budget - 1
+        # Rebuild lost arguments first (transitive reconstruction).
+        for a in spec.args:
+            if a.kind != "ref":
+                continue
+            locs = await self.gcs.call(
+                "obj_loc_get", {"object_id": a.object_id})
+            if not locs and not await self._recover_object(a.object_id):
+                logger.warning(
+                    "cannot reconstruct %s: argument %s lost and not "
+                    "recoverable", spec.name, a.object_id.hex()[:12])
+                return False
+        logger.info("lineage reconstruction: re-executing %s (budget %d)",
+                    spec.name, budget - 1)
+        import copy
+
+        respec = copy.copy(spec)
+        respec.retry_count = 0
+        escrow = []
+        for a in spec.args:
+            if a.kind == "ref":
+                self.refcounter.incref(a.object_id)
+                escrow.append(a.object_id)
+        for rid in spec.return_ids:
+            self.refcounter.incref(rid)
+            escrow.append(rid)
+            self._result_events.setdefault(rid, threading.Event())
+        # Clear free-tombstones for ids being re-created, else the GCS
+        # frees the rebuilt objects the moment they are sealed.
+        await self.gcs.call("ref_revive", {
+            "object_ids": escrow, "holder_id": self.refcounter.holder_id,
+        })
+        await self._drive_task(respec, escrow)
+        return True
+
+    async def _re_put(self, oid: bytes) -> bool:
+        value = self._memory_store.get(oid)
+        if value is None:
+            return False
+        try:
+            head, views = serialization.serialize(value)
+            await self._store_serialized(oid, head, views)
+            logger.info("re-stored lost put object %s", oid.hex()[:12])
+            return True
+        except Exception as e:
+            logger.warning("re-put of %s failed: %s", oid.hex()[:12], e)
+            return False
 
     def wait(
         self,
@@ -301,25 +557,43 @@ class CoreClient:
 
     # ------------------------------------------------------------ tasks
 
-    def _build_args(self, args: tuple, kwargs: dict) -> tuple[list[ArgSpec], list[str]]:
+    def _build_args(
+        self, args: tuple, kwargs: dict
+    ) -> tuple[list[ArgSpec], list[str], list[bytes]]:
+        """Returns (arg specs, kwarg keys, escrowed ids). Escrow: every ref
+        riding the spec — top-level args, refs nested in pickled values, and
+        refs created here for oversized args — gets +1 held by the submitter
+        until the task completes, so in-flight handoffs can't be GC'd
+        (ref: reference_count.h submitted_task_ref_count)."""
         from ray_tpu.api import ObjectRef
 
         specs: list[ArgSpec] = []
+        escrow: list[bytes] = []
         flat = list(args) + list(kwargs.values())
         for a in flat:
             if isinstance(a, ObjectRef):
-                specs.append(ArgSpec(kind="ref", object_id=a.id.binary()))
+                oid = a.id.binary()
+                self.refcounter.incref(oid)
+                escrow.append(oid)
+                specs.append(ArgSpec(kind="ref", object_id=oid))
             else:
-                head, views = serialization.serialize(a)
+                with serialization.capture_refs() as nested:
+                    head, views = serialization.serialize(a)
+                for oid in nested:
+                    self.refcounter.incref(oid)
+                    escrow.append(oid)
                 size = serialization.serialized_size(head, views)
                 if size > self.config.max_inline_object_size:
                     ref = self.put(a)
-                    specs.append(ArgSpec(kind="ref", object_id=ref.id.binary()))
+                    oid = ref.id.binary()
+                    self.refcounter.incref(oid)
+                    escrow.append(oid)
+                    specs.append(ArgSpec(kind="ref", object_id=oid))
                 else:
                     data = bytearray(size)
                     serialization.write_to(memoryview(data), head, views)
                     specs.append(ArgSpec(kind="value", value=bytes(data)))
-        return specs, list(kwargs.keys())
+        return specs, list(kwargs.keys()), escrow
 
     def submit_task(
         self,
@@ -340,11 +614,18 @@ class CoreClient:
         runtime_env = resolve_runtime_env(runtime_env, self)
 
         task_id = TaskID.for_task(JobID(self.job_id))
-        arg_specs, kw_keys = self._build_args(args, kwargs)
+        arg_specs, kw_keys, escrow = self._build_args(args, kwargs)
         n = max(num_returns, 0)
         return_ids = [
             ObjectID.for_return(task_id, i).binary() for i in range(max(n, 1))
         ]
+        # Hold the return ids while the task is in flight: even if the caller
+        # drops its refs immediately, the worker's freshly-stored returns must
+        # not race a free broadcast mid-creation.
+        for rid in return_ids:
+            self.refcounter.mark_owned(rid)
+            self.refcounter.incref(rid)
+            escrow.append(rid)
         spec = TaskSpec(
             kind=NORMAL_TASK,
             task_id=task_id.binary(),
@@ -366,13 +647,32 @@ class CoreClient:
         for rid in return_ids:
             ev = threading.Event()
             self._result_events[rid] = ev
-        asyncio.run_coroutine_threadsafe(self._drive_task(spec), self._loop)
+        if (self.config.lineage_reconstruction_enabled
+                and self.config.ref_counting_enabled  # eviction needs GC
+                and spec.max_retries > 0):            # 0 = user said never rerun
+            # Pin the creating spec while we hold the returns
+            # (ref: task_manager.h:86 lineage pinning).
+            with self._lineage_lock:
+                for rid in return_ids:
+                    self._lineage[rid] = spec
+                self._lineage_budget[spec.task_id] = spec.max_retries
+                for a in arg_specs:
+                    if a.kind == "ref":
+                        self._lineage_deps[a.object_id] = (
+                            self._lineage_deps.get(a.object_id, 0) + 1)
         refs = [ObjectRef(ObjectID(rid)) for rid in return_ids[:max(n, 1)]]
+        asyncio.run_coroutine_threadsafe(
+            self._drive_task(spec, escrow), self._loop)
         return refs if n != 1 else refs[:1]
 
     async def _lease_worker(self, spec: TaskSpec) -> tuple[dict, rpc.Connection]:
         """Lease a worker, following spillback redirects
-        (ref: direct_task_transport.cc:325 RequestNewWorkerIfNeeded)."""
+        (ref: direct_task_transport.cc:325 RequestNewWorkerIfNeeded).
+
+        Spillback chains are bounded: past the hop budget (stale cluster
+        views can bounce a lease briefly) the task QUEUES at the current
+        raylet (`no_spill`) instead of erroring — reference semantics, where
+        saturation means waiting, not failure (cluster_task_manager.cc)."""
         raylet = self.raylet
         raylet_addr = self.raylet_address
         for _hop in range(8):
@@ -388,7 +688,18 @@ class CoreClient:
             if "error" in grant:
                 raise RuntimeError(f"lease failed: {grant['error']}")
             return grant, raylet
-        raise RuntimeError("spillback loop exceeded 8 hops")
+        grant = await raylet.call("request_lease", {
+            "resources": spec.resources,
+            "strategy": spec.scheduling_strategy,
+            "timeout": self.config.lease_timeout_s,
+            "no_spill": True,
+        }, timeout=self.config.lease_timeout_s + 10)
+        if "error" in grant:
+            raise RuntimeError(f"lease failed: {grant['error']}")
+        if "spillback" in grant:
+            raise RuntimeError("lease bounced with no_spill set (infeasible "
+                               "locally); cluster view inconsistent")
+        return grant, raylet
 
     async def _raylet_conn(self, addr: tuple[str, int]) -> rpc.Connection:
         if addr == self.raylet_address:
@@ -406,38 +717,45 @@ class CoreClient:
             self._worker_conns[addr] = conn
         return conn
 
-    async def _drive_task(self, spec: TaskSpec) -> None:
+    async def _drive_task(self, spec: TaskSpec,
+                          escrow: list[bytes] | None = None) -> None:
         """Lease → push → collect returns, with retries on worker death
         (ref: task_manager.h:86 retry bookkeeping)."""
         from ray_tpu.core.task_error import TaskError
 
-        attempts = spec.max_retries + 1
-        last_err: Any = None
-        for attempt in range(attempts):
-            spec.retry_count = attempt
-            try:
-                grant, lessor = await self._lease_worker(spec)
-            except Exception as e:
-                last_err = TaskError("SchedulingError", str(e), "")
-                break
-            worker_addr = tuple(grant["worker_address"])
-            worker_id = grant["worker_id"]
-            try:
-                conn = await self._worker_conn(worker_addr)
-                reply = await conn.call("push_task", {"spec": spec})
-                await lessor.call("release_lease", {"worker_id": worker_id})
-                self._record_returns(spec, reply)
-                return
-            except (rpc.ConnectionLost, rpc.RpcError) as e:
-                await self._safe_release(lessor, worker_id, dead=True)
-                last_err = TaskError(
-                    "WorkerCrashedError",
-                    f"worker died executing {spec.name}: {e}", "",
-                )
-                logger.warning("task %s attempt %d failed: %s",
-                               spec.name, attempt, e)
-                continue
-        self._fail_returns(spec, last_err)
+        try:
+            attempts = spec.max_retries + 1
+            last_err: Any = None
+            for attempt in range(attempts):
+                spec.retry_count = attempt
+                try:
+                    grant, lessor = await self._lease_worker(spec)
+                except Exception as e:
+                    last_err = TaskError("SchedulingError", str(e), "")
+                    break
+                worker_addr = tuple(grant["worker_address"])
+                worker_id = grant["worker_id"]
+                try:
+                    conn = await self._worker_conn(worker_addr)
+                    reply = await conn.call("push_task", {"spec": spec})
+                    await lessor.call("release_lease", {"worker_id": worker_id})
+                    self._record_returns(spec, reply)
+                    return
+                except (rpc.ConnectionLost, rpc.RpcError) as e:
+                    await self._safe_release(lessor, worker_id, dead=True)
+                    last_err = TaskError(
+                        "WorkerCrashedError",
+                        f"worker died executing {spec.name}: {e}", "",
+                    )
+                    logger.warning("task %s attempt %d failed: %s",
+                                   spec.name, attempt, e)
+                    continue
+            self._fail_returns(spec, last_err)
+        finally:
+            # Drop the in-flight escrow; if the caller already released its
+            # refs this cascades into the batched GCS release → object GC.
+            for oid in escrow or ():
+                self.refcounter.decref(oid)
 
     async def _safe_release(self, lessor, worker_id, dead=False):
         try:
@@ -507,7 +825,8 @@ class CoreClient:
         runtime_env=None,
     ):
         task_id = TaskID.for_actor_task(ActorID(st.actor_id))
-        arg_specs, kw_keys = self._build_args(args, kwargs)
+        arg_specs, kw_keys, escrow = self._build_args(args, kwargs)
+        st.creation_escrow = escrow
         spec = TaskSpec(
             kind=ACTOR_CREATION,
             task_id=task_id.binary(),
@@ -581,6 +900,7 @@ class CoreClient:
                 # submission re-places (possibly on a different node)
                 raise _PlacementRetry(str(e))
             st.dead = True
+            self._release_creation_escrow(st)
             st.death_cause = str(e)
             st.ready.set()
             self._fail_returns(spec, TaskError("ActorDiedError", str(e), ""))
@@ -591,6 +911,7 @@ class CoreClient:
                 "actor_id": st.actor_id, "error": "creation task failed",
             })
             st.dead = True
+            self._release_creation_escrow(st)
             st.death_cause = "creation failed"
             st.ready.set()
             return
@@ -613,6 +934,11 @@ class CoreClient:
         st.ready.set()
         self._record_returns(spec, reply)
 
+    def _release_creation_escrow(self, st: ActorState) -> None:
+        escrow, st.creation_escrow = st.creation_escrow, []
+        for oid in escrow:
+            self.refcounter.decref(oid)
+
     def actor_state(self, actor_id: bytes) -> ActorState:
         st = self._actors.get(actor_id)
         if st is None:
@@ -633,11 +959,15 @@ class CoreClient:
 
         st = self.actor_state(actor_id)
         task_id = TaskID.for_actor_task(ActorID(actor_id))
-        arg_specs, kw_keys = self._build_args(args, kwargs)
+        arg_specs, kw_keys, escrow = self._build_args(args, kwargs)
         n = max(num_returns, 0)
         return_ids = [
             ObjectID.for_return(task_id, i).binary() for i in range(max(n, 1))
         ]
+        for rid in return_ids:
+            self.refcounter.mark_owned(rid)
+            self.refcounter.incref(rid)
+            escrow.append(rid)
         spec = TaskSpec(
             kind=ACTOR_TASK,
             task_id=task_id.binary(),
@@ -653,13 +983,22 @@ class CoreClient:
         )
         for rid in return_ids:
             self._result_events[rid] = threading.Event()
-        asyncio.run_coroutine_threadsafe(
-            self._drive_actor_task(st, spec), self._loop
-        )
         refs = [ObjectRef(ObjectID(rid)) for rid in return_ids[:max(n, 1)]]
+        asyncio.run_coroutine_threadsafe(
+            self._drive_actor_task(st, spec, escrow), self._loop
+        )
         return refs if n != 1 else refs[:1]
 
-    async def _drive_actor_task(self, st: ActorState, spec: TaskSpec) -> None:
+    async def _drive_actor_task(self, st: ActorState, spec: TaskSpec,
+                                escrow: list[bytes] | None = None) -> None:
+        try:
+            await self._drive_actor_task_inner(st, spec)
+        finally:
+            for oid in escrow or ():
+                self.refcounter.decref(oid)
+
+    async def _drive_actor_task_inner(self, st: ActorState,
+                                      spec: TaskSpec) -> None:
         from ray_tpu.core.task_error import TaskError
 
         for attempt in range(100):
@@ -675,6 +1014,7 @@ class CoreClient:
                 info = await self.gcs.call("get_actor", {"actor_id": st.actor_id})
                 if info is not None and info["state"] == "DEAD":
                     st.dead = True
+                    self._release_creation_escrow(st)
                     st.death_cause = info.get("death_cause", "not found")
                     continue
                 if info is not None and info["state"] == "ALIVE" and info["address"]:
@@ -757,6 +1097,7 @@ class CoreClient:
                     return
                 if not resp.get("restart"):
                     st.dead = True
+                    self._release_creation_escrow(st)
                     st.death_cause = resp.get("cause", error)
                     st.ready.set()
                     return
@@ -783,6 +1124,7 @@ class CoreClient:
                                              "key": st.actor_id})
         if raw is None:
             st.dead = True
+            self._release_creation_escrow(st)
             st.death_cause = "creation spec lost"
             st.ready.set()
             return
@@ -803,6 +1145,7 @@ class CoreClient:
         st = self.actor_state(actor_id)
         resp = self._run(self.gcs.call("kill_actor", {"actor_id": actor_id}))
         st.dead = True
+        self._release_creation_escrow(st)
         st.death_cause = "killed"
         addr = resp.get("address") if isinstance(resp, dict) else None
         addr = addr or st.address

@@ -64,6 +64,22 @@ class Config:
     # Worker lease request timeout.
     lease_timeout_s: float = 60.0
 
+    # --- reference counting / object GC ---
+    # Automatic distributed ref counting (ref: reference_count.h:61). When
+    # off, objects persist until explicit ray_tpu.free (round-1 behavior).
+    ref_counting_enabled: bool = True
+    # Batched acquire/release flush period per client.
+    ref_flush_interval_s: float = 0.1
+    # Grace after a holder's GCS connection drops before its holds are
+    # released (a reconnecting holder re-registers within this window).
+    ref_holder_grace_s: float = 10.0
+    # Lineage reconstruction (ref: object_recovery_manager.h:41): rebuild
+    # lost objects by re-executing their creating tasks, transitively.
+    lineage_reconstruction_enabled: bool = True
+    # store_get probe window while a get() waits: every interval the client
+    # re-checks liveness and triggers recovery for owned lost objects.
+    get_probe_interval_s: float = 10.0
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_max_frame_bytes: int = 512 * 1024**2

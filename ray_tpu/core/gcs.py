@@ -79,6 +79,20 @@ class GcsServer:
         # Observability (ref: gcs_service.proto AddProfileData; metrics hub)
         self.profile_events: list = []
         self.metrics_by_source: dict[str, list] = {}
+        # ---- distributed ref counting (ref: reference_count.h) ----
+        # Runtime state, deliberately NOT snapshotted: holders re-register
+        # their full held sets on reconnect after a GCS failover.
+        self.ref_holders: dict[bytes, set[bytes]] = {}   # obj → holder ids
+        self.holder_objs: dict[bytes, set[bytes]] = {}   # holder → objs
+        self.holder_conns: dict[bytes, rpc.Connection] = {}
+        self.contained: dict[bytes, list[bytes]] = {}    # outer → inner objs
+        # obj → owner holder id (its creator): recovery requests from
+        # borrowers' failed pulls route here (object_recovery_manager parity).
+        self.obj_owner: dict[bytes, bytes] = {}
+        # Tombstones: recently freed ids; a late location announce for one of
+        # these is answered with an immediate free (stragglers: replicas
+        # sealing after the free broadcast).
+        self._freed_recent: dict[bytes, float] = {}
         self._register_handlers()
 
     # ---------- pubsub ----------
@@ -117,6 +131,10 @@ class GcsServer:
         s.register("obj_loc_remove", self._obj_loc_remove)
         s.register("obj_loc_get", self._obj_loc_get)
         s.register("obj_free", self._obj_free)
+        s.register("ref_register_holder", self._ref_register_holder)
+        s.register("ref_update", self._ref_update)
+        s.register("ref_revive", self._ref_revive)
+        s.register("obj_request_recovery", self._obj_request_recovery)
         s.register("pg_create", self._pg_create)
         s.register("pg_remove", self._pg_remove)
         s.register("pg_get", self._pg_get)
@@ -536,6 +554,12 @@ class GcsServer:
 
     async def _obj_loc_add(self, conn, p):
         for obj in p["object_ids"]:
+            if obj in self._freed_recent:
+                # Straggler seal of an already-freed object: free it there.
+                node_conn = self._node_conns.get(p["node_id"])
+                if node_conn is not None and not node_conn.closed:
+                    node_conn.notify("free_objects", {"object_ids": [obj]})
+                continue
             self.object_dir.setdefault(obj, set()).add(p["node_id"])
         return {"ok": True}
 
@@ -554,13 +578,138 @@ class GcsServer:
         ]
 
     async def _obj_free(self, conn, p):
-        """Broadcast delete to all holders."""
+        """Explicit delete (ray_tpu.free): broadcast to storing nodes and
+        drop any ref-counting state."""
         for obj in p["object_ids"]:
-            for nid in self.object_dir.pop(obj, set()):
-                node_conn = self._node_conns.get(nid)
-                if node_conn is not None and not node_conn.closed:
-                    node_conn.notify("free_objects", {"object_ids": [obj]})
+            self._free_object(obj, tombstone=True)
         return {"ok": True}
+
+    # ---------- distributed ref counting ----------
+    # (ref: core_worker/reference_count.h — here the GCS arbitrates
+    #  process-level holds; exact counts live in each client process)
+
+    MAX_TOMBSTONES = 50_000
+
+    async def _ref_register_holder(self, conn, p):
+        hid = p["holder_id"]
+        self.holder_conns[hid] = conn
+        for obj in p.get("held", ()):
+            self.ref_holders.setdefault(obj, set()).add(hid)
+            self.holder_objs.setdefault(hid, set()).add(obj)
+        # Failover re-registration also replays ownership (recovery routing)
+        # and containment pseudo-holders (refs-in-refs) — the ref tables are
+        # runtime-only state rebuilt entirely from holder announcements.
+        for obj in p.get("owned", ()):
+            self.obj_owner[obj] = hid
+        for outer, inners in p.get("contains", ()):
+            pseudo = b"obj:" + outer
+            bucket = self.contained.setdefault(outer, [])
+            for inner in inners:
+                if inner not in bucket:
+                    self.ref_holders.setdefault(inner, set()).add(pseudo)
+                    bucket.append(inner)
+        return {"ok": True}
+
+    async def _ref_update(self, conn, p):
+        hid = p["holder_id"]
+        self.holder_conns.setdefault(hid, conn)
+        held = self.holder_objs.setdefault(hid, set())
+        for obj in p.get("acquires", ()):
+            self.ref_holders.setdefault(obj, set()).add(hid)
+            held.add(obj)
+        for obj in p.get("owned", ()):
+            self.obj_owner[obj] = hid
+        for outer, inners in p.get("contains", ()):
+            pseudo = b"obj:" + outer
+            bucket = self.contained.setdefault(outer, [])
+            for inner in inners:
+                self.ref_holders.setdefault(inner, set()).add(pseudo)
+                bucket.append(inner)
+        for obj in p.get("releases", ()):
+            held.discard(obj)
+            self._ref_release(hid, obj)
+        for obj in p.get("releases_owned", ()):
+            held.discard(obj)
+            self._ref_release(hid, obj, free_unknown=True)
+        return {"ok": True}
+
+    async def _ref_revive(self, conn, p):
+        """Lineage reconstruction is about to re-store these ids: clear any
+        free-tombstone (else the re-created object is freed on seal) and
+        register the recovering client as a holder."""
+        hid = p["holder_id"]
+        held = self.holder_objs.setdefault(hid, set())
+        for obj in p["object_ids"]:
+            self._freed_recent.pop(obj, None)
+            self.ref_holders.setdefault(obj, set()).add(hid)
+            self.obj_owner[obj] = hid
+            held.add(obj)
+        return {"ok": True}
+
+    async def _obj_request_recovery(self, conn, p):
+        """A raylet's pull found no live copy: ask the object's owner to
+        reconstruct it (lineage re-execution / owner re-put). Fire-and-forget
+        from the raylet's perspective — it keeps polling the directory."""
+        notified = []
+        for obj in p["object_ids"]:
+            hid = self.obj_owner.get(obj)
+            c = self.holder_conns.get(hid) if hid is not None else None
+            if c is not None and not c.closed:
+                c.notify("recover_objects", {"object_ids": [obj]})
+                notified.append(obj)
+        return {"notified": notified}
+
+    def _ref_release(self, holder: bytes, obj: bytes,
+                     free_unknown: bool = False) -> None:
+        holders = self.ref_holders.get(obj)
+        if holders is None:
+            # Never registered. Only the *creator's* release may free it
+            # (put-then-drop before the owner's first flush); a borrower's
+            # release must never race ahead of the owner's initial acquire.
+            if free_unknown:
+                self._free_object(obj)
+            return
+        holders.discard(holder)
+        if not holders:
+            self._free_object(obj)
+
+    def _free_object(self, obj: bytes, tombstone: bool = True) -> None:
+        self.ref_holders.pop(obj, None)
+        owner = self.obj_owner.pop(obj, None)
+        for nid in self.object_dir.pop(obj, set()):
+            node_conn = self._node_conns.get(nid)
+            if node_conn is not None and not node_conn.closed:
+                node_conn.notify("free_objects", {"object_ids": [obj]})
+        # Tell the owner the object is gone cluster-wide so its lineage
+        # pin (kept while remote borrowers might still need recovery) drops.
+        oconn = self.holder_conns.get(owner) if owner is not None else None
+        if oconn is not None and not oconn.closed:
+            oconn.notify("objects_freed", {"object_ids": [obj]})
+        if tombstone:
+            self._freed_recent[obj] = time.monotonic()
+            while len(self._freed_recent) > self.MAX_TOMBSTONES:
+                self._freed_recent.pop(next(iter(self._freed_recent)))
+        # refs-in-refs cascade: the outer object's pseudo-holds die with it.
+        for inner in self.contained.pop(obj, ()):  # noqa: B020
+            self._ref_release(b"obj:" + obj, inner)
+
+    def _drop_holder(self, hid: bytes) -> None:
+        """Release everything a (dead) holder process held."""
+        for obj in self.holder_objs.pop(hid, set()):
+            self._ref_release(hid, obj)
+        self.holder_conns.pop(hid, None)
+
+    def _schedule_holder_cleanup(self, hid: bytes, conn) -> None:
+        """Grace period: a reconnecting holder re-registers before its holds
+        are dropped (parity: owner-death object cleanup,
+        reference_count.h owner-dies semantics)."""
+
+        async def cleanup():
+            await asyncio.sleep(self.config.ref_holder_grace_s)
+            if self.holder_conns.get(hid) is conn:
+                self._drop_holder(hid)
+
+        asyncio.ensure_future(cleanup())
 
     # ---------- failure detection ----------
 
@@ -568,6 +717,9 @@ class GcsServer:
         for nid, c in list(self._node_conns.items()):
             if c is conn:
                 self._mark_node_dead(nid, "connection lost")
+        for hid, c in list(self.holder_conns.items()):
+            if c is conn:
+                self._schedule_holder_cleanup(hid, conn)
 
     def _mark_node_dead(self, node_id: bytes, why: str) -> None:
         info = self.nodes.get(node_id)

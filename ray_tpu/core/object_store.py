@@ -93,6 +93,9 @@ def attach_extent(name: str, offset: int, size: int) -> memoryview:
 INLINE, SHM, SPILLED = "inline", "shm", "spilled"
 
 
+_gen_counter = iter(range(1, 1 << 62)).__next__
+
+
 @dataclass
 class Entry:
     location: str
@@ -104,6 +107,11 @@ class Entry:
     pins: int = 0                      # live zero-copy readers
     doomed: bool = False               # freed while pinned; release at pins==0
     last_used: float = field(default_factory=time.monotonic)
+    # Generation token: distinguishes this entry from an earlier freed+
+    # re-created entry of the same ObjectID, so a reader's unpin targets the
+    # exact extent it read (not "oldest zombie first", which could release a
+    # different connection's extent — advisor finding r1 #1).
+    gen: int = field(default_factory=_gen_counter)
 
 
 class LocalObjectStore:
@@ -226,9 +234,23 @@ class LocalObjectStore:
             if e.pins == 0 and e.doomed:
                 self._release(obj_id, e)
 
-    def unpin(self, obj_id: ObjectID) -> None:
-        """Release one reader pin. Zombie extents (freed + re-created while
-        pinned) are drained first — their pins are the older ones."""
+    def unpin(self, obj_id: ObjectID, gen: int | None = None) -> None:
+        """Release one reader pin. With `gen`, the pin targets exactly the
+        entry generation the reader attached to (live or zombie); without it
+        (legacy callers), zombies drain first."""
+        if gen is not None:
+            e = self.entries.get(obj_id)
+            if e is not None and e.gen == gen:
+                self.pin(obj_id, -1)
+                return
+            for i, (zid, ze) in enumerate(self._zombies):
+                if zid == obj_id and ze.gen == gen:
+                    ze.pins -= 1
+                    if ze.pins <= 0:
+                        self._free_extent(ze)
+                        self._zombies.pop(i)
+                    return
+            return
         for i, (zid, ze) in enumerate(self._zombies):
             if zid == obj_id and ze.pins > 0:
                 ze.pins -= 1
@@ -237,6 +259,10 @@ class LocalObjectStore:
                     self._zombies.pop(i)
                 return
         self.pin(obj_id, -1)
+
+    def entry_gen(self, obj_id: ObjectID) -> int | None:
+        e = self.entries.get(obj_id)
+        return None if e is None else e.gen
 
     def write_bytes(self, obj_id: ObjectID, offset: int, data: bytes) -> None:
         """Daemon-side fill of an unsealed extent (node-to-node pull path)."""

@@ -84,9 +84,9 @@ class Raylet:
                          NodeID(self.node_id).hex()[:8]),
         )
         self.workers: dict[bytes, WorkerHandle] = {}
-        # conn id → {ObjectID: pin count}. Counted (not deduped): an object
-        # freed + re-created between two gets pins two distinct extents, and
-        # unpin drains zombies before live entries in that same order.
+        # conn id → {(ObjectID, entry generation): pin count}. Generation-
+        # tagged so a reader's unpin releases exactly the extent it mmap'd —
+        # never another connection's zombie (freed+re-created) extent.
         self._conn_pins: dict[int, dict] = {}
         self.lease_queue: list[LeaseRequest] = []
         # (pg_id, bundle_index) → {"total": res, "free": res}. Reserved out
@@ -116,6 +116,7 @@ class Raylet:
         s.register("store_get", self._h_store_get)
         s.register("store_contains", self._h_store_contains)
         s.register("store_free", self._h_store_free)
+        s.register("store_release", self._h_store_release)
         s.register("store_stats", self._h_store_stats)
         s.register("store_pin", self._h_store_pin)
         # placement groups (GCS-driven bundle reservation)
@@ -275,9 +276,9 @@ class Raylet:
     def _handle_disconnect(self, conn) -> None:
         # Release zero-copy read pins held by the departed client (plasma
         # releases client refs on disconnect the same way).
-        for obj, n in self._conn_pins.pop(id(conn), {}).items():
+        for (obj, gen), n in self._conn_pins.pop(id(conn), {}).items():
             for _ in range(n):
-                self.store.unpin(obj)
+                self.store.unpin(obj, gen)
         for wid, h in list(self.workers.items()):
             if h.conn is conn:
                 logger.warning("worker %s disconnected", WorkerID(wid).hex()[:8])
@@ -337,9 +338,13 @@ class Raylet:
         ]
         return max(fracs) if fracs else 0.0
 
-    def _pick_spill_node(self, resources: dict[str, float]) -> tuple | None:
-        """Hybrid policy step 2: least-loaded remote feasible node with
-        availability (ref: hybrid_scheduling_policy.h:24-47)."""
+    def _pick_spill_node(self, resources: dict[str, float],
+                         require_available: bool = False) -> tuple | None:
+        """Hybrid policy step 2: least-loaded remote feasible node
+        (ref: hybrid_scheduling_policy.h:24-47). With require_available,
+        only nodes with free capacity qualify — spilling to an equally
+        saturated peer just ping-pongs the lease (it would spill straight
+        back); queue locally instead."""
         best, best_score = None, None
         for nid, n in self.cluster_view.items():
             if nid == self.node_id or not n.get("alive", True):
@@ -348,6 +353,8 @@ class Raylet:
             if not all(tot.get(k, 0) >= v for k, v in resources.items()):
                 continue
             has = all(avail.get(k, 0) >= v for k, v in resources.items())
+            if require_available and not has:
+                continue
             score = (not has, n.get("load", 0))
             if best_score is None or score < best_score:
                 best, best_score = tuple(n["address"]), score
@@ -398,22 +405,29 @@ class Raylet:
             if not affinity.get("soft", False):
                 return {"error": "affinity node not available"}
         if not self._feasible(resources):
+            # This node can never run it: redirect to any feasible node,
+            # busy or not (it will queue there).
             spill = self._pick_spill_node(resources)
             if spill is not None:
                 return {"spillback": spill}
             return {"error": f"no node can satisfy resources {resources}"}
-        # hybrid: spill when saturated locally and someone else has room
-        if (
-            affinity is None
-            and strategy != "LOCAL"
-            and not self._available(resources)
-        ) or (strategy == "SPREAD" and self._utilization() > 0):
-            spill = self._pick_spill_node(resources)
-            if spill is not None and (
-                not self._available(resources)
-                or self._utilization() > self.config.hybrid_threshold
-            ):
-                return {"spillback": spill}
+        # Hybrid: spill when saturated locally and someone else has ROOM —
+        # never to an equally saturated peer (that bounces the lease until
+        # the hop cap; under cluster-wide saturation tasks must queue).
+        # `no_spill` is the client's post-hop-budget fallback: queue here.
+        if not p.get("no_spill"):
+            saturated = (
+                affinity is None
+                and strategy != "LOCAL"
+                and not self._available(resources)
+            )
+            if saturated or (strategy == "SPREAD" and self._utilization() > 0):
+                spill = self._pick_spill_node(resources, require_available=True)
+                if spill is not None and (
+                    saturated
+                    or self._utilization() > self.config.hybrid_threshold
+                ):
+                    return {"spillback": spill}
         req = LeaseRequest(
             resources=resources, strategy=strategy,
             future=asyncio.get_running_loop().create_future(),
@@ -591,14 +605,25 @@ class Raylet:
         Returns per-object: ("inline", bytes) | ("shm", (name, size)) |
         ("missing", None)."""
         timeout = p.get("timeout")
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
         out = []
         for ob in p["object_ids"]:
             obj = ObjectID(ob)
             ok = self.store.contains(obj)
-            if not ok:
-                ok = await self._pull(obj, timeout)
-            if not ok:
-                ok = await self.store.wait_sealed(obj, timeout)
+            # Retry rounds: a lost object may reappear on another node after
+            # owner-side lineage reconstruction; re-consult the directory
+            # every second instead of blocking on the local seal event.
+            while not ok:
+                remaining = (None if deadline is None
+                             else deadline - loop.time())
+                if remaining is not None and remaining <= 0:
+                    break
+                ok = await self._pull(obj, remaining)
+                if ok:
+                    break
+                wait = 1.0 if remaining is None else min(1.0, remaining)
+                ok = await self.store.wait_sealed(obj, wait)
             if not ok:
                 out.append(("missing", None))
             else:
@@ -611,8 +636,9 @@ class Raylet:
                     out.append(("missing", None))
                     continue
                 if loc == "shm":
+                    key = (obj, self.store.entry_gen(obj))
                     pins = self._conn_pins.setdefault(id(conn), {})
-                    pins[obj] = pins.get(obj, 0) + 1
+                    pins[key] = pins.get(key, 0) + 1
                 out.append((loc, data))
         return out
 
@@ -625,13 +651,26 @@ class Raylet:
             # The freeing client has released its own views: drop its pins
             # first so an otherwise-unreferenced extent is reclaimed now
             # rather than parked doomed until disconnect.
-            pins = self._conn_pins.get(id(conn), {})
-            for _ in range(pins.pop(obj, 0)):
-                self.store.unpin(obj)
+            self._drop_conn_pins(conn, obj)
             self.store.free(obj)
             asyncio.ensure_future(self.gcs.call("obj_loc_remove", {
                 "object_id": ob, "node_id": self.node_id,
             }))
+        return {"ok": True}
+
+    def _drop_conn_pins(self, conn, obj: ObjectID) -> None:
+        pins = self._conn_pins.get(id(conn), {})
+        for key in [k for k in pins if k[0] == obj]:
+            n = pins.pop(key)
+            for _ in range(n):
+                self.store.unpin(obj, key[1])
+
+    async def _h_store_release(self, conn, p):
+        """A client released its zero-copy views of these objects (its last
+        ObjectRef died): drop the reader pins it holds via this connection,
+        without freeing the entries."""
+        for ob in p["object_ids"]:
+            self._drop_conn_pins(conn, ObjectID(ob))
         return {"ok": True}
 
     async def _h_store_stats(self, conn, p):
@@ -689,6 +728,16 @@ class Raylet:
 
     async def _pull_once(self, obj: ObjectID, timeout: float | None) -> bool:
         locs = await self.gcs.call("obj_loc_get", {"object_id": obj.binary()})
+        if not locs:
+            # No live copy anywhere: route a reconstruction request to the
+            # owner (ref: object_recovery_manager.h RecoverObject); we keep
+            # polling the directory on subsequent store_get rounds.
+            try:
+                await self.gcs.call("obj_request_recovery", {
+                    "object_ids": [obj.binary()]}, timeout=10.0)
+            except Exception:
+                pass
+            return False
         for loc in locs:
             if loc["node_id"] == self.node_id:
                 continue

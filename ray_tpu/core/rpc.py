@@ -169,6 +169,8 @@ class Server:
     """RPC server. Handlers: async def handler(conn, payload) registered by
     method name. Unknown methods error back to the caller."""
 
+    MAX_DEDUPE = 20_000
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = port
@@ -176,6 +178,19 @@ class Server:
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[Connection] = set()
         self._on_disconnect: Callable[[Connection], None] | None = None
+        # Request-id → result cache: a ReconnectingConnection retrying
+        # through a redial cannot know whether its first attempt executed, so
+        # it tags dict payloads with "_rid"; replays return the cached result
+        # instead of re-running non-idempotent mutations (at-most-once).
+        self._dedupe: dict[bytes, Any] = {}
+        # Idempotent / heavy-read methods skip result caching.
+        self.dedupe_exempt: set[str] = {
+            "heartbeat", "get_cluster_view", "kv_get", "kv_keys", "obj_loc_get",
+            "store_get", "store_contains", "obj_read_chunk", "obj_info",
+            "profile_get", "metrics_get", "ref_update", "ref_register_holder",
+            "ref_revive",
+            "subscribe", "get_actor", "list_actors", "pg_get", "pg_list",
+        }
 
     def handler(self, name: str):
         def deco(fn):
@@ -206,7 +221,16 @@ class Server:
             fn = self._handlers.get(method)
             if fn is None:
                 raise RpcError(f"unknown method {method!r}")
-            return await fn(conn, payload)
+            rid = payload.pop("_rid", None) if isinstance(payload, dict) else None
+            if rid is None or method in self.dedupe_exempt:
+                return await fn(conn, payload)
+            if rid in self._dedupe:
+                return self._dedupe[rid]
+            result = await fn(conn, payload)
+            self._dedupe[rid] = result
+            while len(self._dedupe) > self.MAX_DEDUPE:
+                self._dedupe.pop(next(iter(self._dedupe)))
+            return result
 
         conn._request_handler = dispatch
         conn.start()
@@ -281,6 +305,13 @@ class ReconnectingConnection:
                    timeout: float | None = None) -> Any:
         deadline = (asyncio.get_running_loop().time()
                     + self.reconnect_window_s)
+        # Tag the request so a retry through a redial is deduplicated server-
+        # side: the first attempt may have executed before the drop, and
+        # GCS mutations (next_job_id, register_actor, …) are not idempotent.
+        if isinstance(payload, dict) and "_rid" not in payload:
+            import os as _os
+
+            payload = {**payload, "_rid": _os.urandom(12)}
         while True:
             try:
                 conn = await self._ensure()

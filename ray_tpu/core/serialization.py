@@ -17,11 +17,44 @@ from __future__ import annotations
 
 import pickle
 import struct
+import threading
 from typing import Any, Callable
 
 import cloudpickle
 
 _ALIGN = 8
+
+# ---------------------------------------------------------------- ref capture
+#
+# Distributed ref counting (ref: reference_count.h:511-556 borrowed refs)
+# needs to know which ObjectRefs escape the process inside a serialized
+# value — task args, put() payloads, task returns. ObjectRef.__reduce__
+# reports into the innermost active capture scope.
+
+_capture = threading.local()
+
+
+class capture_refs:
+    """Context manager collecting ObjectRef ids serialized within."""
+
+    def __enter__(self) -> set:
+        stack = getattr(_capture, "stack", None)
+        if stack is None:
+            stack = _capture.stack = []
+        s: set = set()
+        stack.append(s)
+        return s
+
+    def __exit__(self, *exc):
+        _capture.stack.pop()
+        return False
+
+
+def note_ref(oid: bytes) -> None:
+    """Called from ObjectRef.__reduce__ during pickling."""
+    stack = getattr(_capture, "stack", None)
+    if stack:
+        stack[-1].add(oid)
 
 
 def _pad(n: int) -> int:

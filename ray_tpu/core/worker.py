@@ -192,6 +192,23 @@ class Worker:
         reply["returns"] = stored
         if spec.kind == ACTOR_CREATION and error is None:
             reply["actor_address"] = self.address
+        # Flush ref acquires/containments BEFORE replying: the submitter
+        # drops its in-flight escrow on reply, and the GCS must already know
+        # about any refs this task kept (actor state) or returned — a release
+        # must never overtake its matching acquire. Retried through a GCS
+        # failover window; only a multi-minute GCS outage (in which the
+        # escrow release is equally undeliverable) proceeds unflushed.
+        from ray_tpu import api
+
+        if api._client is not None:
+            counter = api._client.refcounter
+            for attempt in range(3):
+                try:
+                    await asyncio.to_thread(counter.flush_now, 60.0, True)
+                    break
+                except Exception as e:
+                    logger.warning("pre-reply ref flush failed "
+                                   "(attempt %d): %s", attempt + 1, e)
         return reply
 
     def _resolve_args(self, spec: TaskSpec) -> tuple[list, dict]:
@@ -279,9 +296,18 @@ class Worker:
 
     async def _store_returns(self, spec: TaskSpec, results: list):
         """→ list of ("inline", bytes) | ("stored", None) per return slot."""
+        from ray_tpu import api
+
         out = []
+        client = api._client
         for obj_id, value in zip(spec.return_ids, results):
-            head, views = serialization.serialize(value)
+            with serialization.capture_refs() as nested:
+                head, views = serialization.serialize(value)
+            if nested and client is not None:
+                # Returned value embeds ObjectRefs: the stored return keeps
+                # them alive (refs-in-refs, reference_count.h:534). Flushed
+                # before the task reply below.
+                client.refcounter.add_contains(obj_id, nested)
             size = serialization.serialized_size(head, views)
             if size <= self.config.max_inline_object_size:
                 data = bytearray(size)

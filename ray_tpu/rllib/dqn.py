@@ -106,16 +106,22 @@ class DQN(Algorithm):
                 explore, self._rng.integers(0, self.n_actions, env.num_envs),
                 greedy)
             next_obs, reward, done, trunc = env.step(actions)
+            # Truncated transitions keep done=False (bootstrapping past a
+            # time limit is correct) but must store the PRE-reset successor
+            # obs — next_obs at finished rows is the new episode's reset obs.
+            finished_rows = np.logical_or(done, trunc)
+            stored_next = np.where(
+                finished_rows.reshape((-1,) + (1,) * (next_obs.ndim - 1)),
+                env.final_obs, next_obs)
             self.buffer.add(SampleBatch({
                 sb.OBS: obs.astype(np.float32),
                 sb.ACTIONS: actions.astype(np.int64),
                 sb.REWARDS: reward.astype(np.float32),
                 sb.DONES: done,
-                sb.NEXT_OBS: next_obs.astype(np.float32),
+                sb.NEXT_OBS: stored_next.astype(np.float32),
             }))
             worker._running_return += reward
-            finished = np.logical_or(done, trunc)
-            for i in np.nonzero(finished)[0]:
+            for i in np.nonzero(finished_rows)[0]:
                 worker.episode_returns.append(float(worker._running_return[i]))
                 worker._running_return[i] = 0.0
             obs = next_obs

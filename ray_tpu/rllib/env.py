@@ -50,12 +50,15 @@ class VectorEnv:
 
     def step(self, actions: np.ndarray):
         """→ (obs, reward, done, truncated). Done sub-envs auto-reset; the
-        returned obs for them is the *new* episode's first obs (the sampler
-        records the pre-reset terminal flags)."""
+        returned obs for them is the *new* episode's first obs. The PRE-reset
+        terminal observation is kept in `self.final_obs` so samplers can
+        bootstrap truncated episodes through v(s_{T+1}) of the *old* episode
+        rather than the reset observation (standard time-limit handling)."""
         reward, done = self._step(actions)
         self.t += 1
         trunc = np.logical_and(self.t >= self.max_steps, ~done)
         finished = np.logical_or(done, trunc)
+        self.final_obs = self._obs()
         if finished.any():
             idx = np.nonzero(finished)[0]
             self._reset_idx(idx)

@@ -60,6 +60,7 @@ class RolloutWorker:
             sb.TRUNCS: np.zeros((T, N), bool),
             sb.LOGP: np.zeros((T, N), np.float32),
             sb.VF_PREDS: np.zeros((T, N), np.float32),
+            sb.BOOTSTRAP_VALUES: np.zeros((T, N), np.float32),
         }
         for t in range(T):
             self.key, sub = jax.random.split(self.key)
@@ -75,6 +76,13 @@ class RolloutWorker:
             cols[sb.REWARDS][t] = reward
             cols[sb.DONES][t] = done
             cols[sb.TRUNCS][t] = trunc
+            if trunc.any():
+                # Bootstrap truncated sub-envs through the value of the
+                # PRE-reset terminal obs (env.final_obs), not the reset obs.
+                self.key, sub = jax.random.split(self.key)
+                _, _, vf_fin = self.policy.compute_actions(
+                    self.env.final_obs, sub)
+                cols[sb.BOOTSTRAP_VALUES][t] = np.where(trunc, vf_fin, 0.0)
             self._running_return += reward
             finished = np.logical_or(done, trunc)
             for i in np.nonzero(finished)[0]:

@@ -20,6 +20,10 @@ LOGP = "logp"
 VF_PREDS = "vf_preds"
 ADVANTAGES = "advantages"
 VALUE_TARGETS = "value_targets"
+# v(pre-reset terminal obs) at truncated steps; 0 elsewhere. Lets GAE
+# bootstrap time-limit truncations through the true successor state instead
+# of the auto-reset observation.
+BOOTSTRAP_VALUES = "bootstrap_values"
 
 
 class SampleBatch(dict):
@@ -63,22 +67,26 @@ def compute_gae(
     next-state value (standard time-limit handling).
     """
     rewards = batch[REWARDS]
-    dones = batch[DONES].astype(np.float32)
+    dones = batch[DONES].astype(bool)
     vf = batch[VF_PREDS]
     T, N = rewards.shape
+    truncs = (batch[TRUNCS].astype(bool) if TRUNCS in batch
+              else np.zeros((T, N), bool))
+    # v(s_{t+1}) of the pre-reset terminal state at truncated steps. Without
+    # the column, fall back to cutting the bootstrap (biased but never wrong
+    # across episode boundaries — the next row's vf is a reset obs).
+    boot = (batch[BOOTSTRAP_VALUES] if BOOTSTRAP_VALUES in batch
+            else np.zeros((T, N), np.float32))
     adv = np.zeros((T, N), np.float32)
     next_v = last_values.astype(np.float32)
     gae = np.zeros(N, np.float32)
     for t in range(T - 1, -1, -1):
-        nonterminal = 1.0 - dones[t]
-        # Truncated (time-limit) steps also stop the GAE recursion but keep
-        # the bootstrap value; `bootstrap_values` column carries v(s_{t+1}).
-        if TRUNCS in batch:
-            cut = np.logical_or(batch[DONES][t], batch[TRUNCS][t])
-        else:
-            cut = batch[DONES][t]
-        delta = rewards[t] + gamma * next_v * nonterminal - vf[t]
-        gae = delta + gamma * lam * nonterminal * np.where(cut, 0.0, gae)
+        finished = np.logical_or(dones[t], truncs[t])
+        # Successor value: 0 past a true terminal; the recorded pre-reset
+        # value past a truncation; otherwise v(s_{t+1}) from the next row.
+        succ_v = np.where(dones[t], 0.0, np.where(truncs[t], boot[t], next_v))
+        delta = rewards[t] + gamma * succ_v - vf[t]
+        gae = delta + gamma * lam * np.where(finished, 0.0, gae)
         adv[t] = gae
         next_v = vf[t]
     out = SampleBatch(batch)

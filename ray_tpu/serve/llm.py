@@ -62,7 +62,13 @@ class LLMEngine:
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len
-        self.buckets = tuple(sorted(prefill_buckets))
+        # Clamp buckets to the KV-cache capacity: _bucket() rounds a prompt
+        # UP, so a bucket larger than max_len would trace a prefill whose
+        # dynamic_update_slice overruns the cache (advisor finding r1 #3).
+        buckets = tuple(sorted(b for b in prefill_buckets if b < max_len))
+        if not buckets:
+            buckets = (max(1, max_len - 1),)
+        self.buckets = buckets
         self.params = params if params is not None else gpt.init_params(
             cfg, jax.random.key(seed))
         self.cache = init_kv_cache(cfg, n_slots, max_len)

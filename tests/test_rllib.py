@@ -95,7 +95,31 @@ class TestGAE:
         np.testing.assert_allclose(
             out[sb.VALUE_TARGETS], out[sb.ADVANTAGES] + 0.5, rtol=1e-5)
 
-    def test_truncation_stops_recursion_but_bootstraps(self):
+    def test_truncation_bootstraps_through_recorded_value(self):
+        """A truncated step must bootstrap through v(pre-reset terminal obs)
+        carried in BOOTSTRAP_VALUES — NOT through vf of the next row, which
+        after auto-reset belongs to a NEW episode."""
+        batch = SampleBatch({
+            sb.REWARDS: np.ones((3, 1), np.float32),
+            sb.DONES: np.zeros((3, 1), bool),
+            sb.TRUNCS: np.array([[False], [True], [False]]),
+            sb.VF_PREDS: np.full((3, 1), 0.5, np.float32),
+            sb.BOOTSTRAP_VALUES: np.array(
+                [[0.0], [2.0], [0.0]], np.float32),
+        })
+        out = compute_gae(batch, np.zeros(1, np.float32), gamma=1.0, lam=1.0)
+        # Step 2 (new episode): delta2 = 1 + 0*last_v - 0.5 = 0.5.
+        assert out[sb.ADVANTAGES][2, 0] == pytest.approx(0.5)
+        # Step 1 truncated: bootstraps the RECORDED 2.0, chain from step 2
+        # cut: delta1 = 1 + 2.0 - 0.5 = 2.5.
+        assert out[sb.ADVANTAGES][1, 0] == pytest.approx(2.5)
+        # Step 0 chains through step 1 (same episode):
+        # delta0 = 1 + 0.5 - 0.5 = 1.0; adv0 = delta0 + gae1 = 3.5.
+        assert out[sb.ADVANTAGES][0, 0] == pytest.approx(3.5)
+
+    def test_truncation_without_column_cuts_bootstrap(self):
+        """No BOOTSTRAP_VALUES column → safe fallback: treat truncation like
+        a terminal (never bootstrap across the auto-reset boundary)."""
         batch = SampleBatch({
             sb.REWARDS: np.ones((3, 1), np.float32),
             sb.DONES: np.zeros((3, 1), bool),
@@ -103,11 +127,8 @@ class TestGAE:
             sb.VF_PREDS: np.full((3, 1), 0.5, np.float32),
         })
         out = compute_gae(batch, np.zeros(1, np.float32), gamma=1.0, lam=1.0)
-        # Step 1 truncated: the chain from step 2 (a new episode) must not
-        # flow into step 1, but step 0 chains through step 1 (same episode).
-        assert out[sb.ADVANTAGES][2, 0] == pytest.approx(0.5)  # delta2 only
-        assert out[sb.ADVANTAGES][1, 0] == pytest.approx(1.0)  # chain cut
-        assert out[sb.ADVANTAGES][0, 0] == pytest.approx(2.0)  # delta0+gae1
+        assert out[sb.ADVANTAGES][1, 0] == pytest.approx(0.5)  # 1 + 0 - 0.5
+        assert out[sb.ADVANTAGES][0, 0] == pytest.approx(1.5)  # delta0 + gae1
 
 
 class TestReplay:
