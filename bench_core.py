@@ -1,0 +1,196 @@
+"""Core runtime microbenchmarks — the ray_perf suite equivalent.
+
+Mirrors the reference's single-node op-throughput suite
+(`/root/reference/python/ray/_private/ray_perf.py:93-297`): task submit
+ops/s (sync + async batches), actor call ops/s, small put/get ops/s, and
+large-object put/get bandwidth. Run:
+
+    python bench_core.py [--json-out BENCH_CORE.json]
+
+Prints one JSON line per metric and (optionally) writes them all to a file.
+These are host-side control-plane numbers — independent of the TPU compute
+path — and are the regression baseline for scheduler/transport work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import time
+
+import numpy as np
+
+
+def _rate(n: int, dt: float) -> float:
+    return round(n / dt, 1)
+
+
+def bench_task_sync(n: int = 200) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote())  # warm a worker
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(nop.remote())
+    dt = time.perf_counter() - t0
+    return {"metric": "task_sync_ops_per_s", "value": _rate(n, dt),
+            "unit": "ops/s", "n": n}
+
+
+def bench_task_async(n: int = 1000, batch: int = 100) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote())
+    t0 = time.perf_counter()
+    done = 0
+    while done < n:
+        refs = [nop.remote() for _ in range(batch)]
+        ray_tpu.get(refs)
+        done += batch
+    dt = time.perf_counter() - t0
+    return {"metric": "task_async_ops_per_s", "value": _rate(n, dt),
+            "unit": "ops/s", "n": n, "batch": batch}
+
+
+def bench_actor_sync(n: int = 500) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(a.ping.remote())
+    dt = time.perf_counter() - t0
+    return {"metric": "actor_sync_ops_per_s", "value": _rate(n, dt),
+            "unit": "ops/s", "n": n}
+
+
+def bench_actor_async(n: int = 2000, batch: int = 200) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    t0 = time.perf_counter()
+    done = 0
+    while done < n:
+        ray_tpu.get([a.ping.remote() for _ in range(batch)])
+        done += batch
+    dt = time.perf_counter() - t0
+    return {"metric": "actor_async_ops_per_s", "value": _rate(n, dt),
+            "unit": "ops/s", "n": n, "batch": batch}
+
+
+def bench_put_small(n: int = 1000) -> dict:
+    import ray_tpu
+
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(i) for i in range(n)]
+    dt = time.perf_counter() - t0
+    del refs
+    gc.collect()
+    return {"metric": "put_small_ops_per_s", "value": _rate(n, dt),
+            "unit": "ops/s", "n": n}
+
+
+def bench_put_gigabytes(total_mb: int = 512, chunk_mb: int = 64) -> dict:
+    import ray_tpu
+
+    chunk = np.random.default_rng(0).integers(
+        0, 255, chunk_mb << 20, np.uint8)
+    n = total_mb // chunk_mb
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(chunk) for _ in range(n)]
+    dt = time.perf_counter() - t0
+    rate = total_mb / 1024 / dt
+    del refs
+    gc.collect()
+    return {"metric": "put_large_gib_per_s", "value": round(rate, 3),
+            "unit": "GiB/s", "total_mb": total_mb}
+
+
+def bench_get_large(mb: int = 256) -> dict:
+    import ray_tpu
+    from ray_tpu import api
+
+    arr = np.random.default_rng(0).integers(0, 255, mb << 20, np.uint8)
+    ref = ray_tpu.put(arr)
+    client = api._client
+    client._memory_store.pop(ref.id.binary(), None)  # force store read
+    t0 = time.perf_counter()
+    out = ray_tpu.get(ref)
+    dt = time.perf_counter() - t0
+    assert out[0] == arr[0]
+    return {"metric": "get_large_gib_per_s",
+            "value": round(mb / 1024 / dt, 3), "unit": "GiB/s", "mb": mb}
+
+
+def bench_queued_tasks(n: int = 2000) -> dict:
+    """Many tasks queued at once (scalability-envelope direction:
+    reference sustains 1M queued on one node)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def nop(i):
+        return i
+
+    t0 = time.perf_counter()
+    refs = [nop.remote(i) for i in range(n)]
+    submit_dt = time.perf_counter() - t0
+    out = ray_tpu.get(refs, timeout=600)
+    total_dt = time.perf_counter() - t0
+    assert out[-1] == n - 1
+    return {"metric": "queued_tasks_throughput_per_s",
+            "value": _rate(n, total_dt), "unit": "tasks/s", "n": n,
+            "submit_ops_per_s": _rate(n, submit_dt)}
+
+
+ALL = [bench_task_sync, bench_task_async, bench_actor_sync,
+       bench_actor_async, bench_put_small, bench_put_gigabytes,
+       bench_get_large, bench_queued_tasks]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json-out", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated metric-function names")
+    args = ap.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    rows = []
+    only = set(args.only.split(",")) if args.only else None
+    for fn in ALL:
+        if only and fn.__name__ not in only:
+            continue
+        row = fn()
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(rows, f, indent=1)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,17 @@ class ActorDiedError(RuntimeError):
     pass
 
 
+class _PendingTask:
+    """A queued normal task awaiting a lease lane."""
+
+    __slots__ = ("spec", "done", "attempts")
+
+    def __init__(self, spec, done, attempts):
+        self.spec = spec
+        self.done = done
+        self.attempts = attempts
+
+
 class ActorState:
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
@@ -81,6 +92,8 @@ class CoreClient:
             target=self._loop.run_forever, name="ray_tpu-client", daemon=True
         )
         self._thread.start()
+        # set before the GCS connection exists: _notify may fire immediately
+        self._channel_subs: dict[str, list] = {}
         self.gcs: rpc.ReconnectingConnection = self._run(
             self._connect_gcs(gcs_address))
         self.raylet: rpc.Connection = self._run(self._connect(raylet_address))
@@ -107,6 +120,15 @@ class CoreClient:
         # (ref: reference_count.h lineage refs).
         self._lineage_deps: dict[bytes, int] = {}
         self._recoveries: dict[bytes, asyncio.Future] = {}  # task_id → done
+        # Per-scheduling-key task queues + lease lanes (ref: the submitter's
+        # per-SchedulingKey pipeline, direct_task_transport.cc:108-220): one
+        # granted lease executes queued same-shape tasks back-to-back, so the
+        # lease/release round trip amortizes across a burst instead of
+        # costing two raylet RPCs per task.
+        self._pending_by_key: dict[tuple, Any] = {}
+        self._lanes: dict[tuple, int] = {}
+        self._idle_lanes: dict[tuple, int] = {}
+        self._key_events: dict[tuple, asyncio.Event] = {}
         self._closed = False
         # Distributed ref counting (ref: reference_count.h:61): exact local
         # counts here, batched process-level holds to the GCS.
@@ -136,7 +158,8 @@ class CoreClient:
 
     async def _connect_gcs(self, addr) -> rpc.ReconnectingConnection:
         async def on_reconnect(conn):
-            await conn.call("subscribe", {"channels": ["actor"]})
+            channels = ["actor", *self._channel_subs]
+            await conn.call("subscribe", {"channels": channels})
             # GCS failover: ref tables are runtime state, rebuilt by holders
             # re-announcing everything — holds, owned ids, containment.
             if self.config.ref_counting_enabled and hasattr(self, "refcounter"):
@@ -153,7 +176,25 @@ class CoreClient:
         await conn._ensure()
         return conn
 
+    def subscribe_channel(self, channel: str, callback) -> None:
+        """Register a pubsub callback for `pub:<channel>` notifies from the
+        GCS (long-poll fan-out parity). Callbacks run on the client loop —
+        keep them non-blocking."""
+        self._channel_subs.setdefault(channel, []).append(callback)
+        self._run(self.gcs.call("subscribe", {"channels": [channel]}))
+
+    def publish(self, channel: str, message: Any) -> None:
+        self._run(self.gcs.call("publish", {
+            "channel": channel, "message": message,
+        }), timeout=30)
+
     def _notify(self, method: str, payload: Any) -> None:
+        if method.startswith("pub:"):
+            for cb in self._channel_subs.get(method[4:], ()):
+                try:
+                    cb(payload)
+                except Exception:
+                    logger.exception("pubsub callback failed")
         if method == "objects_freed":
             # The GCS freed these owned objects cluster-wide: no holder
             # remains anywhere, so their lineage pins can finally drop.
@@ -680,6 +721,7 @@ class CoreClient:
                 "resources": spec.resources,
                 "strategy": spec.scheduling_strategy,
                 "timeout": self.config.lease_timeout_s,
+                "retriable": spec.max_retries > 0,
             }, timeout=self.config.lease_timeout_s + 10)
             if "spillback" in grant:
                 raylet_addr = tuple(grant["spillback"])
@@ -692,6 +734,7 @@ class CoreClient:
             "resources": spec.resources,
             "strategy": spec.scheduling_strategy,
             "timeout": self.config.lease_timeout_s,
+            "retriable": spec.max_retries > 0,
             "no_spill": True,
         }, timeout=self.config.lease_timeout_s + 10)
         if "error" in grant:
@@ -719,43 +762,171 @@ class CoreClient:
 
     async def _drive_task(self, spec: TaskSpec,
                           escrow: list[bytes] | None = None) -> None:
-        """Lease → push → collect returns, with retries on worker death
-        (ref: task_manager.h:86 retry bookkeeping)."""
-        from ray_tpu.core.task_error import TaskError
-
+        """Enqueue on the scheduling-key pipeline and await completion
+        (lease → push → returns, retries on worker death — ref:
+        task_manager.h:86 retry bookkeeping + direct_task_transport.cc
+        per-key lease pipeline)."""
         try:
-            attempts = spec.max_retries + 1
-            last_err: Any = None
-            for attempt in range(attempts):
-                spec.retry_count = attempt
-                try:
-                    grant, lessor = await self._lease_worker(spec)
-                except Exception as e:
-                    last_err = TaskError("SchedulingError", str(e), "")
-                    break
-                worker_addr = tuple(grant["worker_address"])
-                worker_id = grant["worker_id"]
-                try:
-                    conn = await self._worker_conn(worker_addr)
-                    reply = await conn.call("push_task", {"spec": spec})
-                    await lessor.call("release_lease", {"worker_id": worker_id})
-                    self._record_returns(spec, reply)
-                    return
-                except (rpc.ConnectionLost, rpc.RpcError) as e:
-                    await self._safe_release(lessor, worker_id, dead=True)
-                    last_err = TaskError(
-                        "WorkerCrashedError",
-                        f"worker died executing {spec.name}: {e}", "",
-                    )
-                    logger.warning("task %s attempt %d failed: %s",
-                                   spec.name, attempt, e)
-                    continue
-            self._fail_returns(spec, last_err)
+            pt = _PendingTask(spec, asyncio.get_running_loop().create_future(),
+                              spec.max_retries + 1)
+            key = self._sched_key(spec)
+            q = self._pending_by_key.get(key)
+            if q is None:
+                import collections
+
+                q = self._pending_by_key[key] = collections.deque()
+            q.append(pt)
+            ev = self._key_events.get(key)
+            if ev is None:
+                ev = self._key_events[key] = asyncio.Event()
+            ev.set()
+            self._ensure_lanes(key)
+            await pt.done
         finally:
             # Drop the in-flight escrow; if the caller already released its
             # refs this cascades into the batched GCS release → object GC.
             for oid in escrow or ():
                 self.refcounter.decref(oid)
+
+    @staticmethod
+    def _sched_key(spec: TaskSpec) -> tuple:
+        strat = spec.scheduling_strategy
+        if isinstance(strat, dict):
+            strat = tuple(sorted(
+                (k, v if isinstance(v, (str, int, float, bytes, bool,
+                                        type(None))) else repr(v))
+                for k, v in strat.items()))
+        return (tuple(sorted(spec.resources.items())), strat)
+
+    def _ensure_lanes(self, key: tuple) -> None:
+        """Spawn lanes so every queued task can run CONCURRENTLY (up to the
+        cap): busy lanes don't count — gang-style tasks (collectives) block
+        each other if serialized onto one lane. Extra lanes cost one
+        unnecessary lease request and exit after the keepalive."""
+        q = self._pending_by_key.get(key)
+        if not q:
+            return
+        cap = self.config.max_lease_lanes_per_key
+        need = len(q) - self._idle_lanes.get(key, 0)
+        while need > 0 and self._lanes.get(key, 0) < cap:
+            self._lanes[key] = self._lanes.get(key, 0) + 1
+            asyncio.ensure_future(self._lease_lane(key))
+            need -= 1
+
+    async def _keepalive_wait(self, key: tuple) -> bool:
+        """Idle-lane wait: up to lease_keepalive_s for a new same-key task.
+        True = a task is (probably) queued; False = release the lease.
+        Spurious wakeups (N lanes woken for one task) resume waiting within
+        the same deadline, keeping the other lanes' leases warm."""
+        ev = self._key_events.get(key)
+        if ev is None or self._closed:
+            return False
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.config.lease_keepalive_s
+        self._idle_lanes[key] = self._idle_lanes.get(key, 0) + 1
+        try:
+            while True:
+                if self._pending_by_key.get(key):
+                    return True
+                remaining = deadline - loop.time()
+                if remaining <= 0 or self._closed:
+                    return False
+                ev.clear()
+                if self._pending_by_key.get(key):  # set-before-clear race
+                    return True
+                try:
+                    await asyncio.wait_for(ev.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return False
+        finally:
+            self._idle_lanes[key] = self._idle_lanes.get(key, 1) - 1
+
+    async def _lease_lane(self, key: tuple) -> None:
+        from ray_tpu.core.task_error import TaskError
+
+        try:
+            while not self._closed:
+                q = self._pending_by_key.get(key)
+                if not q:
+                    return
+                head = q[0]
+                try:
+                    grant, lessor = await self._lease_worker(head.spec)
+                except Exception as e:
+                    q = self._pending_by_key.get(key)
+                    if q:
+                        pt = q.popleft()
+                        self._fail_returns(pt.spec, TaskError(
+                            "SchedulingError", str(e), ""))
+                        if not pt.done.done():
+                            pt.done.set_result(None)
+                    continue
+                worker_id = grant["worker_id"]
+                worker_dead = False
+                try:
+                    try:
+                        conn = await self._worker_conn(
+                            tuple(grant["worker_address"]))
+                    except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+                        # Worker died between grant and connect (OOM kill,
+                        # crash): report the lease dead and re-lease. No
+                        # task was charged an attempt — none was pushed.
+                        logger.warning("leased worker unreachable: %s", e)
+                        worker_dead = True
+                        continue
+                    # Pipeline queued same-key tasks onto this lease.
+                    while True:
+                        q = self._pending_by_key.get(key)
+                        if not q:
+                            # Keep the lease warm until the keepalive
+                            # deadline: spurious wakeups (another lane won
+                            # the race for a single new task) resume
+                            # waiting instead of dropping the warm lease.
+                            if not await self._keepalive_wait(key):
+                                break
+                        q = self._pending_by_key.get(key)
+                        if not q:
+                            break
+                        pt = q.popleft()
+                        pt.spec.retry_count = (
+                            pt.spec.max_retries + 1 - pt.attempts)
+                        try:
+                            reply = await conn.call(
+                                "push_task", {"spec": pt.spec})
+                        except (rpc.ConnectionLost, rpc.RpcError) as e:
+                            worker_dead = True
+                            pt.attempts -= 1
+                            if pt.attempts > 0:
+                                logger.warning(
+                                    "task %s failed (%s); retrying "
+                                    "(%d attempts left)",
+                                    pt.spec.name, e, pt.attempts)
+                                q.appendleft(pt)
+                            else:
+                                self._fail_returns(pt.spec, TaskError(
+                                    "WorkerCrashedError",
+                                    f"worker died executing "
+                                    f"{pt.spec.name}: {e}", ""))
+                                if not pt.done.done():
+                                    pt.done.set_result(None)
+                            break
+                        self._record_returns(pt.spec, reply)
+                        if not pt.done.done():
+                            pt.done.set_result(None)
+                finally:
+                    await self._safe_release(lessor, worker_id,
+                                             dead=worker_dead)
+        except Exception:
+            # A lane must never die silently with tasks queued: waiting
+            # submitters would hang on their done futures. Log, then respawn
+            # a replacement lane for whatever is still queued.
+            logger.exception("lease lane crashed; respawning")
+            if self._pending_by_key.get(key) and not self._closed:
+                asyncio.get_running_loop().call_later(
+                    0.1, self._ensure_lanes, key)
+        finally:
+            self._lanes[key] = self._lanes.get(key, 1) - 1
+
 
     async def _safe_release(self, lessor, worker_id, dead=False):
         try:

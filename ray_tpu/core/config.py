@@ -49,8 +49,28 @@ class Config:
     max_workers_per_node: int = 64
     # Prestarted idle workers per node.
     prestart_workers: int = 0
+    # Concurrent lease lanes per scheduling key (ref: the per-SchedulingKey
+    # submitter pipeline, direct_task_transport.cc:108-220). Each lane holds
+    # one lease and runs queued same-shape tasks back-to-back. Must exceed
+    # the largest gang of same-key tasks that block on each other
+    # (host-rendezvous collectives): serialized gang members deadlock.
+    max_lease_lanes_per_key: int = 128
+    # How long a drained lease lane keeps its worker before releasing —
+    # sync call chains and back-to-back batches reuse the lease without a
+    # fresh raylet round trip (ref: worker_lease_timeout_milliseconds).
+    lease_keepalive_s: float = 0.2
     # Seconds an idle worker survives before reaping.
     idle_worker_ttl_s: float = 300.0
+
+    # --- memory protection (ref: common/memory_monitor.h:48 +
+    #     raylet/worker_killing_policy.h:58 RetriableLIFO) ---
+    # Host memory-usage fraction above which the raylet kills workers.
+    memory_usage_threshold: float = 0.95
+    # Optional absolute cap on the summed RSS of this node's workers
+    # (bytes; 0 = disabled). Mainly for tests and co-tenant machines.
+    memory_limit_bytes: int = 0
+    # Monitor period; 0 disables the monitor entirely.
+    memory_monitor_period_s: float = 1.0
 
     # --- fault tolerance ---
     # Heartbeat period and miss budget

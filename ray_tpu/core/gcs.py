@@ -116,6 +116,7 @@ class GcsServer:
         s.register("get_cluster_view", self._get_cluster_view)
         s.register("drain_node", self._drain_node)
         s.register("subscribe", self._subscribe)
+        s.register("publish", self._publish_rpc)
         s.register("next_job_id", self._next_job_id)
         s.register("kv_put", self._kv_put)
         s.register("kv_get", self._kv_get)
@@ -198,6 +199,14 @@ class GcsServer:
     async def _subscribe(self, conn, p):
         for channel in p["channels"]:
             self.subscribers.setdefault(channel, set()).add(conn)
+        return {"ok": True}
+
+    async def _publish_rpc(self, conn, p):
+        """Application-level pubsub (ref: pubsub_handler.cc GCS channels):
+        any client may publish; subscribers get `pub:<channel>` notifies —
+        the push fan-out used by e.g. Serve's routing-table invalidation
+        (long_poll.py parity)."""
+        self.publish(p["channel"], p["message"])
         return {"ok": True}
 
     async def _next_job_id(self, conn, p):

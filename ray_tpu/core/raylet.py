@@ -41,6 +41,7 @@ class WorkerHandle:
     idle: bool = True
     actor_id: bytes | None = None            # pinned if hosting an actor
     lease_resources: dict[str, float] = field(default_factory=dict)
+    lease_retriable: bool = True             # current task can retry (OOM kill)
     bundle_key: tuple | None = None          # (pg_id, index) when PG-backed
     started: float = field(default_factory=time.monotonic)
     proc: Any = None
@@ -52,6 +53,7 @@ class LeaseRequest:
     strategy: Any
     future: asyncio.Future
     bundle_key: tuple | None = None          # grant from this PG bundle
+    retriable: bool = True                   # OOM-kill preference hint
     enqueued: float = field(default_factory=time.monotonic)
 
 
@@ -163,6 +165,8 @@ class Raylet:
         self.cluster_view = view
         asyncio.ensure_future(self._heartbeat_loop())
         asyncio.ensure_future(self._reap_idle_loop())
+        if self.config.memory_monitor_period_s > 0:
+            asyncio.ensure_future(self._memory_monitor_loop())
         for _ in range(self.config.prestart_workers):
             self._spawn_worker()
         logger.info(
@@ -317,6 +321,93 @@ class Raylet:
                 if h.conn is not None:
                     h.conn.notify("exit", {})
 
+    # ------------------------------------------------- memory protection
+    # (ref: common/memory_monitor.h:48 UsageAboveThreshold +
+    #  raylet/worker_killing_policy.h:58 RetriableLIFOWorkerKillingPolicy)
+
+    @staticmethod
+    def _host_memory_fraction() -> float:
+        try:
+            total = avail = None
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1]) * 1024
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1]) * 1024
+                    if total is not None and avail is not None:
+                        break
+            if not total or avail is None:
+                # Unknown usage must read as "no pressure" — treating it as
+                # full would turn the monitor into a kill-everything loop.
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    @staticmethod
+    def _proc_rss(pid: int) -> int:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, IndexError, ValueError):
+            return 0
+
+    def _pick_oom_victim(self) -> WorkerHandle | None:
+        """RetriableLIFO: newest-leased retriable task worker first, then
+        newest non-retriable task worker; actor workers only as a last
+        resort (killing an actor loses state; a task retries cheaply)."""
+        busy = [h for h in self.workers.values()
+                if not h.idle and h.conn is not None and h.actor_id is None]
+        if busy:
+            retriable = [h for h in busy if h.lease_retriable]
+            pool = retriable or busy
+            return max(pool, key=lambda h: h.started)
+        actors = [h for h in self.workers.values()
+                  if h.actor_id is not None and h.conn is not None]
+        if actors:
+            return max(actors, key=lambda h: h.started)
+        return None
+
+    async def _memory_monitor_loop(self) -> None:
+        cfg = self.config
+        while not self._shutdown:
+            await asyncio.sleep(cfg.memory_monitor_period_s)
+            try:
+                frac = self._host_memory_fraction()
+                over_host = frac > cfg.memory_usage_threshold
+                over_limit = False
+                if cfg.memory_limit_bytes:
+                    rss = sum(self._proc_rss(h.pid)
+                              for h in self.workers.values() if h.pid > 0)
+                    over_limit = rss > cfg.memory_limit_bytes
+                if not (over_host or over_limit):
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                logger.warning(
+                    "memory pressure (host=%.0f%%%s): killing newest %s "
+                    "worker %s (pid %d); its task will retry",
+                    frac * 100,
+                    " + worker-rss over limit" if over_limit else "",
+                    "retriable" if victim.lease_retriable else "busy",
+                    WorkerID(victim.worker_id).hex()[:8], victim.pid,
+                )
+                if victim.proc is not None:
+                    try:
+                        victim.proc.kill()
+                    except ProcessLookupError:
+                        pass
+                elif victim.pid > 0:
+                    try:
+                        os.kill(victim.pid, 9)
+                    except ProcessLookupError:
+                        pass
+                # disconnect handling returns resources + pumps the queue
+            except Exception:
+                logger.exception("memory monitor iteration failed")
+
     # ------------------------------------------------------- leasing
 
     def _feasible(self, resources: dict[str, float]) -> bool:
@@ -430,6 +521,7 @@ class Raylet:
                     return {"spillback": spill}
         req = LeaseRequest(
             resources=resources, strategy=strategy,
+            retriable=p.get("retriable", True),
             future=asyncio.get_running_loop().create_future(),
         )
         self.lease_queue.append(req)
@@ -482,6 +574,7 @@ class Raylet:
             return {"error": "no alive node holds the requested bundle"}
         req = LeaseRequest(
             resources=resources, strategy=strategy, bundle_key=key,
+            retriable=p.get("retriable", True),
             future=asyncio.get_running_loop().create_future(),
         )
         self.lease_queue.append(req)
@@ -522,6 +615,7 @@ class Raylet:
                 continue
             worker.idle = False
             worker.lease_resources = dict(req.resources)
+            worker.lease_retriable = req.retriable
             worker.bundle_key = req.bundle_key
             if req.bundle_key is not None:
                 free = self.pg_bundles[req.bundle_key]["free"]
@@ -582,22 +676,34 @@ class Raylet:
         name, offset = await self.store.create(ObjectID(p["object_id"]), p["size"])
         return {"arena": name, "offset": offset}
 
+    def _announce_locations(self, object_ids: list[bytes]) -> None:
+        """Fire-and-forget directory announce: the store reply must not wait
+        a GCS round trip (remote getters' pulls retry against the directory
+        every second, so a lagging announce only delays a pull, never loses
+        an object)."""
+
+        async def go():
+            try:
+                await self.gcs.call("obj_loc_add", {
+                    "object_ids": object_ids, "node_id": self.node_id,
+                }, timeout=30.0)
+            except Exception as e:
+                logger.warning("location announce failed: %s", e)
+
+        asyncio.ensure_future(go())
+
     async def _h_store_seal(self, conn, p):
         obj = ObjectID(p["object_id"])
         self.store.seal(obj)
         if not p.get("local_only"):
-            await self.gcs.call("obj_loc_add", {
-                "object_ids": [p["object_id"]], "node_id": self.node_id,
-            })
+            self._announce_locations([p["object_id"]])
         return {"ok": True}
 
     async def _h_store_put_inline(self, conn, p):
         obj = ObjectID(p["object_id"])
         self.store.put_inline(obj, p["data"])
         if not p.get("local_only"):
-            await self.gcs.call("obj_loc_add", {
-                "object_ids": [p["object_id"]], "node_id": self.node_id,
-            })
+            self._announce_locations([p["object_id"]])
         return {"ok": True}
 
     async def _h_store_get(self, conn, p):

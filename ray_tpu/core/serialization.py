@@ -62,16 +62,19 @@ def _pad(n: int) -> int:
 
 
 def _to_host(obj: Any) -> Any:
-    """jax.Array → numpy before pickling (device buffers can't pickle)."""
-    try:
-        import jax
+    """jax.Array → numpy before pickling (device buffers can't pickle).
 
-        if isinstance(obj, jax.Array):
-            import numpy as np
+    Never IMPORTS jax: a jax.Array can only exist in this process if jax is
+    already in sys.modules, and a cold jax import here (30s+ when several
+    fresh workers start concurrently under the axon plugin discovery) would
+    sit directly in the task store-returns hot path."""
+    import sys
 
-            return np.asarray(obj)
-    except ImportError:
-        pass
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(obj, jax.Array):
+        import numpy as np
+
+        return np.asarray(obj)
     return obj
 
 

@@ -18,6 +18,32 @@ from ray_tpu.core import serialization
 CONTROLLER_NAME = "ray_tpu_serve_controller"
 _local = threading.local()
 
+# One routing-push subscription per process (not per handle): every
+# DeploymentHandle reads the shared pushed version; re-subscribes if the
+# client was re-initialized.
+_push_state = {"version": -1, "client": None}
+
+
+def _pushed_version() -> int:
+    from ray_tpu import api as _api
+    from ray_tpu.serve.controller import ROUTES_CHANNEL
+
+    client = _api._ensure_client()
+    if _push_state["client"] is not client:
+        _push_state["client"] = client
+        _push_state["version"] = -1
+
+        def on_push(payload, _c=client):
+            if _push_state["client"] is _c:
+                _push_state["version"] = max(
+                    _push_state["version"], payload.get("version", -1))
+
+        try:
+            client.subscribe_channel(ROUTES_CHANNEL, on_push)
+        except Exception:
+            pass
+    return _push_state["version"]
+
 
 def _get_controller(create: bool = False):
     try:
@@ -63,6 +89,10 @@ class Deployment:
     user_config: Any = None
     init_args: tuple = ()
     init_kwargs: dict = field(default_factory=dict)
+    # {"min_replicas", "max_replicas", "target_ongoing_requests",
+    #  "upscale_delay_s", "downscale_delay_s"} — queue-depth autoscaling
+    # (ref: _private/autoscaling_policy.py). None = fixed num_replicas.
+    autoscaling_config: dict | None = None
 
     def options(self, **kw) -> "Deployment":
         import dataclasses
@@ -82,7 +112,8 @@ def deployment(_func_or_class=None, *, name: str | None = None,
                num_replicas: int = 1, route_prefix: str | None = None,
                ray_actor_options: dict | None = None,
                max_concurrent_queries: int = 8,
-               user_config: Any = None):
+               user_config: Any = None,
+               autoscaling_config: dict | None = None):
     def make(target):
         return Deployment(
             func_or_class=target,
@@ -95,6 +126,7 @@ def deployment(_func_or_class=None, *, name: str | None = None,
             ray_actor_options=ray_actor_options,
             max_concurrent_queries=max_concurrent_queries,
             user_config=user_config,
+            autoscaling_config=autoscaling_config,
         )
 
     if _func_or_class is not None:
@@ -104,9 +136,12 @@ def deployment(_func_or_class=None, *, name: str | None = None,
 
 class DeploymentHandle:
     """Client-side handle: routes calls to replicas with power-of-two-choices
-    (ref: router.py ReplicaSet)."""
+    (ref: router.py ReplicaSet). Routing-table updates arrive by PUSH: the
+    controller publishes version bumps on GCS pubsub (long_poll.py parity),
+    so scaling/deletion is visible at the next call — the TTL is only a
+    safety net against a lost notify."""
 
-    REFRESH_TTL_S = 1.0
+    REFRESH_TTL_S = 10.0
 
     def __init__(self, deployment_name: str):
         self.deployment_name = deployment_name
@@ -115,6 +150,10 @@ class DeploymentHandle:
         self._rr = 0
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        try:
+            _pushed_version()  # arm the process-level push subscription
+        except Exception:
+            pass
 
     def _refresh(self, force: bool = False):
         ctrl = _get_controller()
@@ -147,7 +186,9 @@ class DeploymentHandle:
         for attempt in range(4):
             with self._lock:
                 stale = (
-                    time.monotonic() - self._last_refresh > self.REFRESH_TTL_S
+                    self._version < _pushed_version()
+                    or time.monotonic() - self._last_refresh
+                    > self.REFRESH_TTL_S
                 )
                 replicas = self._alive(self._replicas)
             if replicas and not stale:
@@ -202,6 +243,7 @@ def run(target: Deployment, *, name: str | None = None,
         dep.name, cls_blob, dep.init_args, dep.init_kwargs,
         dep.num_replicas, dep.route_prefix, resources,
         dep.max_concurrent_queries, dep.user_config,
+        dep.autoscaling_config,
     ), timeout=timeout)
     handle = DeploymentHandle(dep.name)
     if _blocking_until_ready:

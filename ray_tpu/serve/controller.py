@@ -3,16 +3,20 @@
 Parity: `/root/reference/python/ray/serve/controller.py:61` +
 `_private/deployment_state.py:1767` — reconciles desired deployment state
 (replica count, config, user code version) against actual replica actors,
-restarts dead replicas, and serves routing tables to handles/proxies (the
-reference fans these out via LongPollHost; here handles poll with a version
-counter, same effect).
+restarts dead replicas, autoscales on observed load
+(`_private/autoscaling_policy.py` BasicAutoscalingPolicy), and pushes
+routing-table invalidations to handles/proxies over GCS pubsub
+(`_private/long_poll.py:40` LongPollHost parity).
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Any
+
+ROUTES_CHANNEL = "serve_routes"
 
 
 class ServeController:
@@ -34,7 +38,23 @@ class ServeController:
                route_prefix: str | None,
                resources: dict | None,
                max_concurrent_queries: int = 8,
-               user_config: Any = None) -> bool:
+               user_config: Any = None,
+               autoscaling_config: dict | None = None) -> bool:
+        if autoscaling_config:
+            ac = dict(autoscaling_config)
+            ac.setdefault("min_replicas", 1)
+            # Scale-to-zero is unsupported: with zero replicas there is no
+            # load signal to scale back up from (the reference measures
+            # handle-side queues; here metrics come from replicas).
+            ac["min_replicas"] = max(1, ac["min_replicas"])
+            ac.setdefault("max_replicas", max(num_replicas, 1))
+            ac.setdefault("target_ongoing_requests", 2.0)
+            ac.setdefault("upscale_delay_s", 0.5)
+            ac.setdefault("downscale_delay_s", 5.0)
+            num_replicas = max(
+                ac["min_replicas"], min(num_replicas, ac["max_replicas"]))
+        else:
+            ac = None
         with self._lock:
             old = self.deployments.get(name)
             self.deployments[name] = {
@@ -47,13 +67,18 @@ class ServeController:
                 "resources": resources,
                 "max_concurrent_queries": max_concurrent_queries,
                 "user_config": user_config,
+                "autoscaling": ac,
+                # autoscaler bookkeeping: when the load first crossed the
+                # scale-up/-down threshold (None = not currently crossed)
+                "over_since": None,
+                "under_since": None,
                 "replicas": old["replicas"] if old else [],
                 "generation": (old["generation"] + 1) if old else 0,
             }
             if old:
                 # config/code changed → roll all replicas
                 self._drain_replicas(self.deployments[name], all=True)
-            self.version += 1
+            self._bump_version_locked()
         self._reconcile_once()
         return True
 
@@ -62,7 +87,7 @@ class ServeController:
             d = self.deployments.pop(name, None)
             if d:
                 self._drain_replicas(d, all=True)
-            self.version += 1
+            self._bump_version_locked()
         return True
 
     def get_routing(self, known_version: int = -1) -> dict | None:
@@ -86,6 +111,7 @@ class ServeController:
                     "num_replicas": d["num_replicas"],
                     "live_replicas": len(d["replicas"]),
                     "route_prefix": d["route_prefix"],
+                    "autoscaling": d.get("autoscaling"),
                 }
                 for name, d in self.deployments.items()
             }
@@ -96,10 +122,28 @@ class ServeController:
             for d in self.deployments.values():
                 self._drain_replicas(d, all=True)
             self.deployments.clear()
-            self.version += 1
+            self._bump_version_locked()
         return True
 
     # ------------------------------------------------------------ reconcile
+
+    def _bump_version_locked(self) -> None:
+        """Version bump + push invalidation to every subscribed handle/proxy
+        (LongPollHost parity — scaling events visible in <1s, no TTL). The
+        publish itself runs on a worker thread: a slow/failing GCS must not
+        stall the controller lock."""
+        self.version += 1
+        v = self.version
+
+        def _publish():
+            try:
+                from ray_tpu import api as _api
+
+                _api._ensure_client().publish(ROUTES_CHANNEL, {"version": v})
+            except Exception:
+                pass
+
+        threading.Thread(target=_publish, daemon=True).start()
 
     def _drain_replicas(self, d: dict, all: bool = False, keep: int = 0):
         import ray_tpu
@@ -120,25 +164,80 @@ class ServeController:
                 pass
             time.sleep(0.5)
 
+    def _autoscale_decision(self, d: dict, stats: list | None) -> None:
+        """Queue-depth autoscaling (ref: autoscaling_policy.py
+        BasicAutoscalingPolicy.get_decision_num_replicas): desired =
+        ceil(total ongoing / target per replica), clamped to [min, max],
+        applied after a sustained threshold crossing (up fast, down slow).
+        Called under the lock with PRE-GATHERED stats."""
+        ac = d.get("autoscaling")
+        if not ac or stats is None:
+            return
+        total_ongoing = sum(s["inflight"] + s.get("queued", 0)
+                            for s in stats)
+        desired = math.ceil(total_ongoing / max(
+            ac["target_ongoing_requests"], 1e-9))
+        desired = max(ac["min_replicas"], min(desired, ac["max_replicas"]))
+        now = time.monotonic()
+        cur = d["num_replicas"]
+        if desired > cur:
+            d["under_since"] = None
+            if d["over_since"] is None:
+                d["over_since"] = now
+            if now - d["over_since"] >= ac["upscale_delay_s"]:
+                d["num_replicas"] = desired
+                d["over_since"] = None
+        elif desired < cur:
+            d["over_since"] = None
+            if d["under_since"] is None:
+                d["under_since"] = now
+            if now - d["under_since"] >= ac["downscale_delay_s"]:
+                d["num_replicas"] = desired
+                d["under_since"] = None
+        else:
+            d["over_since"] = None
+            d["under_since"] = None
+
     def _reconcile_once(self):
         """Desired → actual: start missing replicas, reap dead ones
-        (ref: deployment_state.py:958 reconcile loop)."""
+        (ref: deployment_state.py:958 reconcile loop).
+
+        Blocking probes (health checks, load stats) run OUTSIDE the lock so
+        an unresponsive replica can't freeze get_routing/deploy; results are
+        applied under the lock only if the deployment generation is
+        unchanged."""
         import ray_tpu
-        from ray_tpu.core import serialization
         from ray_tpu.serve.replica import Replica
 
         with self._lock:
-            for d in self.deployments.values():
-                # health-check existing replicas
-                alive = []
-                changed = False
-                for aid, handle in d["replicas"]:
-                    try:
+            snapshot = [
+                (name, d["generation"], list(d["replicas"]),
+                 bool(d.get("autoscaling")))
+                for name, d in self.deployments.items()
+            ]
+        probed: dict[str, tuple[int, list, list | None]] = {}
+        for name, gen, replicas, wants_stats in snapshot:
+            alive = []
+            stats: list | None = [] if wants_stats else None
+            for aid, handle in replicas:
+                try:
+                    if wants_stats:
+                        s = ray_tpu.get(handle.stats.remote(), timeout=10)
+                        stats.append(s)
+                    else:
                         ray_tpu.get(handle.health.remote(), timeout=10)
-                        alive.append((aid, handle))
-                    except Exception:
-                        changed = True
+                    alive.append((aid, handle))
+                except Exception:
+                    pass
+            probed[name] = (gen, alive, stats)
+        with self._lock:
+            for name, (gen, alive, stats) in probed.items():
+                d = self.deployments.get(name)
+                if d is None or d["generation"] != gen:
+                    continue  # redeployed/deleted mid-probe
+                changed = len(alive) != len(d["replicas"])
                 d["replicas"] = alive
+                self._autoscale_decision(d, stats)
                 while len(d["replicas"]) > d["num_replicas"]:
                     self._drain_replicas(d, keep=d["num_replicas"])
                     changed = True
@@ -154,4 +253,4 @@ class ServeController:
                     d["replicas"].append((h._actor_id.hex(), h))
                     changed = True
                 if changed:
-                    self.version += 1
+                    self._bump_version_locked()

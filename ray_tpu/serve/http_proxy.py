@@ -74,6 +74,16 @@ class HTTPProxy:
             target=self._server.serve_forever, daemon=True
         )
         self._thread.start()
+        self._route_dirty = threading.Event()
+        self._route_dirty.set()
+        try:
+            from ray_tpu import api as _api
+            from ray_tpu.serve.controller import ROUTES_CHANNEL
+
+            _api._ensure_client().subscribe_channel(
+                ROUTES_CHANNEL, lambda _p: self._route_dirty.set())
+        except Exception:
+            pass
         self._refresher = threading.Thread(target=self._refresh_loop,
                                            daemon=True)
         self._refresher.start()
@@ -98,12 +108,14 @@ class HTTPProxy:
             return h
 
     def _refresh_loop(self):
-        import time
-
+        """Route table updates are push-driven (GCS pubsub invalidation, ref
+        long_poll.py); the 5s timeout is a lost-notify safety net."""
         import ray_tpu
         from ray_tpu.serve.api import _get_controller
 
         while True:
+            self._route_dirty.wait(timeout=5.0)
+            self._route_dirty.clear()
             try:
                 ctrl = _get_controller()
                 table = ray_tpu.get(ctrl.get_routing.remote(-1), timeout=30)
@@ -116,7 +128,6 @@ class HTTPProxy:
                         }
             except Exception:
                 pass
-            time.sleep(0.5)
 
     def get_port(self) -> int:
         return self.port
