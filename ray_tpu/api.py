@@ -43,6 +43,11 @@ class RayTaskError(Exception):
         super().__init__(f"{exc_type}: {message}\n--- remote traceback ---\n{tb}")
 
 
+class TaskCancelledError(RayTaskError):
+    """The task was cancelled via ray_tpu.cancel
+    (ref: exceptions.py TaskCancelledError)."""
+
+
 class ObjectRef:
     """Future-like handle to an object in the cluster.
 
@@ -220,7 +225,7 @@ def shutdown() -> None:
 
 _TASK_ONLY = {"num_returns", "max_retries"}
 _ACTOR_ONLY = {"max_restarts", "max_concurrency", "name", "get_if_exists",
-               "lifetime", "max_task_retries"}
+               "lifetime", "max_task_retries", "concurrency_groups"}
 _COMMON = {"num_cpus", "num_tpus", "resources", "scheduling_strategy",
            "runtime_env", "placement_group", "placement_group_bundle_index"}
 
@@ -318,13 +323,17 @@ def _strategy_payload(o: dict):
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 concurrency_group: str | None = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int = 1) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, num_returns: int = 1,
+                concurrency_group: str | None = None) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns,
+                           concurrency_group)
 
     def remote(self, *args, **kwargs):
         client = _ensure_client()
@@ -332,6 +341,7 @@ class ActorMethod:
             self._handle._actor_id.binary(),
             self._name, args, kwargs,
             num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group,
         )
         return refs[0] if self._num_returns == 1 else refs
 
@@ -395,6 +405,7 @@ class ActorClass:
             actor_name=o.get("name"),
             get_if_exists=o.get("get_if_exists", False),
             runtime_env=o.get("runtime_env"),
+            concurrency_groups=o.get("concurrency_groups"),
         )
         return ActorHandle(ActorID(actor_id))
 
@@ -455,9 +466,17 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     _ensure_client().kill_actor(actor._actor_id.binary(), no_restart)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    # v1: cooperative cancel not yet implemented; reserved API surface.
-    logger.warning("cancel() is best-effort and not yet implemented")
+def cancel(ref: ObjectRef, *, force: bool = False,
+           recursive: bool = False) -> bool:
+    """Cancel the task producing `ref` (ref: _private/worker.py:2389).
+
+    Queued tasks are unqueued and fail with TaskCancelledError; running
+    tasks receive a cooperative async exception on their executing thread
+    (async actor calls get asyncio cancellation); force=True kills the
+    executing worker process. Returns True if a cancellation was delivered.
+    `recursive` is accepted for API parity (child tasks are not tracked).
+    """
+    return _ensure_client().cancel_task(ref.id.binary(), force)
 
 
 def get_actor(name: str) -> ActorHandle:

@@ -51,12 +51,17 @@ class ActorDiedError(RuntimeError):
 class _PendingTask:
     """A queued normal task awaiting a lease lane."""
 
-    __slots__ = ("spec", "done", "attempts")
+    __slots__ = ("spec", "done", "attempts", "key", "state", "worker_conn",
+                 "canceled")
 
     def __init__(self, spec, done, attempts):
         self.spec = spec
         self.done = done
         self.attempts = attempts
+        self.key = None
+        self.state = "queued"          # queued | running | done
+        self.worker_conn = None
+        self.canceled = False
 
 
 class ActorState:
@@ -108,6 +113,12 @@ class CoreClient:
         self._worker_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._raylet_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._result_events: dict[bytes, threading.Event] = {}
+        # asyncio twins of _result_events, used for dependency resolution:
+        # a task whose ref args are still being produced BY THIS CLIENT is
+        # not enqueued until they land (ref: dependency_resolver.cc) — else
+        # bounded worker pools deadlock with consumers blocking on
+        # producers that can't get a worker.
+        self._return_ready: dict[bytes, asyncio.Event] = {}
         # Lineage (ref: object_recovery_manager.h:41, task_manager.h:86
         # lineage pinning): return id → the TaskSpec that creates it, kept
         # while this process holds a reference, so lost objects can be
@@ -129,6 +140,8 @@ class CoreClient:
         self._lanes: dict[tuple, int] = {}
         self._idle_lanes: dict[tuple, int] = {}
         self._key_events: dict[tuple, asyncio.Event] = {}
+        # first-return-id → pending record, for ray_tpu.cancel
+        self._task_index: dict[bytes, Any] = {}
         self._closed = False
         # Distributed ref counting (ref: reference_count.h:61): exact local
         # counts here, batched process-level holds to the GCS.
@@ -686,8 +699,8 @@ class CoreClient:
             runtime_env=runtime_env,
         )
         for rid in return_ids:
-            ev = threading.Event()
-            self._result_events[rid] = ev
+            self._result_events[rid] = threading.Event()
+            self._return_ready[rid] = asyncio.Event()
         if (self.config.lineage_reconstruction_enabled
                 and self.config.ref_counting_enabled  # eviction needs GC
                 and spec.max_retries > 0):            # 0 = user said never rerun
@@ -769,7 +782,13 @@ class CoreClient:
         try:
             pt = _PendingTask(spec, asyncio.get_running_loop().create_future(),
                               spec.max_retries + 1)
+            if spec.return_ids:
+                self._task_index[spec.return_ids[0]] = pt
+            await self._await_local_deps(spec)
+            if pt.state == "done":   # cancelled while waiting on deps
+                return
             key = self._sched_key(spec)
+            pt.key = key
             q = self._pending_by_key.get(key)
             if q is None:
                 import collections
@@ -783,10 +802,82 @@ class CoreClient:
             self._ensure_lanes(key)
             await pt.done
         finally:
+            if spec.return_ids:
+                self._task_index.pop(spec.return_ids[0], None)
             # Drop the in-flight escrow; if the caller already released its
             # refs this cascades into the batched GCS release → object GC.
             for oid in escrow or ():
                 self.refcounter.decref(oid)
+
+    def cancel_task(self, oid: bytes, force: bool = False) -> bool:
+        """ray_tpu.cancel: queued tasks unqueue and fail with
+        TaskCancelledError; running tasks get a cooperative async exception
+        on their executing thread (or asyncio-task cancel for async actors);
+        force=True kills the worker process (ref: _private/worker.py:2389 +
+        CoreWorker::HandleCancelTask)."""
+        return self._run(self._cancel_async(oid, force))
+
+    async def _cancel_async(self, oid: bytes, force: bool) -> bool:
+        from ray_tpu.core.task_error import TaskError
+
+        pt = self._task_index.get(oid)
+        if pt is None:
+            return False
+        cancelled_err = TaskError(
+            "TaskCancelledError", "cancelled before execution", "")
+        if isinstance(pt, dict):            # actor task entry
+            if pt["state"] == "queued":
+                pt["canceled"] = True
+                return True
+            st = pt["st"]
+            conn = st.conn
+            if conn is not None and not conn.closed:
+                try:
+                    await conn.call("cancel_task", {
+                        "task_id": pt["spec"].task_id, "force": force,
+                    }, timeout=10)
+                    return True
+                except Exception:
+                    return False
+            return False
+        pt.canceled = True
+        if pt.state == "queued":
+            q = self._pending_by_key.get(pt.key) if pt.key else None
+            if q is not None:
+                try:
+                    q.remove(pt)
+                except ValueError:
+                    pass
+            pt.state = "done"
+            self._fail_returns(pt.spec, cancelled_err)
+            if not pt.done.done():
+                pt.done.set_result(None)
+            return True
+        if pt.state == "running" and pt.worker_conn is not None:
+            try:
+                r = await pt.worker_conn.call("cancel_task", {
+                    "task_id": pt.spec.task_id, "force": force,
+                }, timeout=10)
+                return bool(r.get("ok"))
+            except Exception:
+                # force-kill drops the connection before the reply lands;
+                # the lane's canceled check finishes the job.
+                return force
+        return False
+
+    async def _await_local_deps(self, spec: TaskSpec) -> None:
+        """Defer dispatch until ref args this client is still producing have
+        landed (ref: dependency_resolver.cc LocalDependencyResolver). Without
+        this, consumers occupy the bounded worker pool blocking on producers
+        that then can't get a worker — a deadlock, not just a slowdown.
+        Foreign refs (other clients' objects) resolve worker-side as before.
+        """
+        for a in spec.args:
+            if a.kind != "ref":
+                continue
+            aev = self._return_ready.get(a.object_id)
+            if aev is not None:
+                await aev.wait()
 
     @staticmethod
     def _sched_key(spec: TaskSpec) -> tuple:
@@ -888,6 +979,8 @@ class CoreClient:
                         if not q:
                             break
                         pt = q.popleft()
+                        pt.state = "running"
+                        pt.worker_conn = conn
                         pt.spec.retry_count = (
                             pt.spec.max_retries + 1 - pt.attempts)
                         try:
@@ -896,13 +989,24 @@ class CoreClient:
                         except (rpc.ConnectionLost, rpc.RpcError) as e:
                             worker_dead = True
                             pt.attempts -= 1
-                            if pt.attempts > 0:
+                            if pt.canceled:
+                                # force-cancel killed the worker (or the
+                                # crash raced a cancel): do NOT re-execute.
+                                pt.state = "done"
+                                self._fail_returns(pt.spec, TaskError(
+                                    "TaskCancelledError", "cancelled", ""))
+                                if not pt.done.done():
+                                    pt.done.set_result(None)
+                            elif pt.attempts > 0:
                                 logger.warning(
                                     "task %s failed (%s); retrying "
                                     "(%d attempts left)",
                                     pt.spec.name, e, pt.attempts)
+                                pt.state = "queued"
+                                pt.worker_conn = None
                                 q.appendleft(pt)
                             else:
+                                pt.state = "done"
                                 self._fail_returns(pt.spec, TaskError(
                                     "WorkerCrashedError",
                                     f"worker died executing "
@@ -910,6 +1014,7 @@ class CoreClient:
                                 if not pt.done.done():
                                     pt.done.set_result(None)
                             break
+                        pt.state = "done"
                         self._record_returns(pt.spec, reply)
                         if not pt.done.done():
                             pt.done.set_result(None)
@@ -944,6 +1049,9 @@ class CoreClient:
             ev = self._result_events.pop(rid, None)
             if ev is not None:
                 ev.set()
+            aev = self._return_ready.pop(rid, None)
+            if aev is not None:
+                aev.set()
 
     def _fail_returns(self, spec: TaskSpec, err) -> None:
         from ray_tpu.core.task_error import TaskError
@@ -955,6 +1063,9 @@ class CoreClient:
             ev = self._result_events.pop(rid, None)
             if ev is not None:
                 ev.set()
+            aev = self._return_ready.pop(rid, None)
+            if aev is not None:
+                aev.set()
 
     # ------------------------------------------------------------ actors
 
@@ -972,6 +1083,7 @@ class CoreClient:
         actor_name: str | None = None,
         get_if_exists: bool = False,
         runtime_env: dict | None = None,
+        concurrency_groups: dict[str, int] | None = None,
     ) -> bytes:
         from ray_tpu.core.runtime_env import resolve_runtime_env
 
@@ -984,7 +1096,7 @@ class CoreClient:
         result = self._run(self._create_actor_async(
             st, cls_blob, name, args, kwargs, resources, hold_resources,
             max_restarts, max_concurrency, actor_name, get_if_exists,
-            runtime_env,
+            runtime_env, concurrency_groups,
         ))
         if isinstance(result, bytes):       # got existing named actor
             return result
@@ -993,7 +1105,7 @@ class CoreClient:
     async def _create_actor_async(
         self, st, cls_blob, name, args, kwargs, resources, hold_resources,
         max_restarts, max_concurrency, actor_name, get_if_exists,
-        runtime_env=None,
+        runtime_env=None, concurrency_groups=None,
     ):
         task_id = TaskID.for_actor_task(ActorID(st.actor_id))
         arg_specs, kw_keys, escrow = self._build_args(args, kwargs)
@@ -1015,6 +1127,7 @@ class CoreClient:
             max_concurrency=max_concurrency,
             actor_name=actor_name,
             runtime_env=runtime_env,
+            concurrency_groups=concurrency_groups,
         )
         reg = await self.gcs.call("register_actor", {
             "actor_id": st.actor_id,
@@ -1125,6 +1238,7 @@ class CoreClient:
         kwargs: dict,
         *,
         num_returns: int = 1,
+        concurrency_group: str | None = None,
     ) -> list:
         from ray_tpu.api import ObjectRef
 
@@ -1151,9 +1265,15 @@ class CoreClient:
             return_ids=return_ids,
             actor_id=actor_id,
             method_name=method_name,
+            concurrency_group=concurrency_group,
         )
         for rid in return_ids:
             self._result_events[rid] = threading.Event()
+            self._return_ready[rid] = asyncio.Event()
+        self._task_index[return_ids[0]] = {
+            "kind": "actor", "st": st, "spec": spec,
+            "state": "queued", "canceled": False,
+        }
         refs = [ObjectRef(ObjectID(rid)) for rid in return_ids[:max(n, 1)]]
         asyncio.run_coroutine_threadsafe(
             self._drive_actor_task(st, spec, escrow), self._loop
@@ -1163,8 +1283,15 @@ class CoreClient:
     async def _drive_actor_task(self, st: ActorState, spec: TaskSpec,
                                 escrow: list[bytes] | None = None) -> None:
         try:
+            # NOTE: no _await_local_deps here — delaying dispatch on a
+            # pending local dep would let later no-dep calls overtake this
+            # one, breaking per-caller actor ordering. Ref args resolve
+            # worker-side; actor workers are dedicated, so that blocking
+            # can't starve the shared task pool.
             await self._drive_actor_task_inner(st, spec)
         finally:
+            if spec.return_ids:
+                self._task_index.pop(spec.return_ids[0], None)
             for oid in escrow or ():
                 self.refcounter.decref(oid)
 
@@ -1173,6 +1300,12 @@ class CoreClient:
         from ray_tpu.core.task_error import TaskError
 
         for attempt in range(100):
+            entry = (self._task_index.get(spec.return_ids[0])
+                     if spec.return_ids else None)
+            if isinstance(entry, dict) and entry.get("canceled"):
+                self._fail_returns(spec, TaskError(
+                    "TaskCancelledError", "cancelled before execution", ""))
+                return
             if st.dead:
                 self._fail_returns(spec, TaskError(
                     "ActorDiedError",
@@ -1216,6 +1349,15 @@ class CoreClient:
                     conn = await self._worker_conn(st.address)
                     st.conn = conn
                 spec.seq_no = next(st.seq)
+                entry = (self._task_index.get(spec.return_ids[0])
+                         if spec.return_ids else None)
+                if isinstance(entry, dict):
+                    if entry.get("canceled"):
+                        self._fail_returns(spec, TaskError(
+                            "TaskCancelledError",
+                            "cancelled before execution", ""))
+                        return
+                    entry["state"] = "running"
                 reply = await conn.call("push_task", {"spec": spec})
                 if reply.get("status") == "actor_missing":
                     st.address = None

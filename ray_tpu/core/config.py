@@ -108,6 +108,15 @@ class Config:
     # gcs_failover_worker_reconnect_timeout).
     gcs_reconnect_window_s: float = 60.0
 
+    # --- GCS durability (ref: gcs/store_client/redis_store_client.h — the
+    #     reference persists every table write to Redis; here a per-mutation
+    #     WAL + periodic snapshot compaction) ---
+    # Snapshot compaction period; the WAL makes the interval a compaction
+    # knob, not a durability window (r1 lost everything since the last tick).
+    gcs_snapshot_interval_s: float = 10.0
+    # fsync each WAL append (survives machine crash, not just process kill).
+    gcs_wal_fsync: bool = False
+
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"
 

@@ -21,9 +21,11 @@ class TaskError:
         self.cause = cause
 
     def to_exception(self) -> Exception:
-        from ray_tpu.api import RayTaskError
+        from ray_tpu.api import RayTaskError, TaskCancelledError
 
-        return RayTaskError(self.exc_type, self.message, self.tb)
+        cls = (TaskCancelledError if self.exc_type == "TaskCancelledError"
+               else RayTaskError)
+        return cls(self.exc_type, self.message, self.tb)
 
     def __repr__(self):
         return f"TaskError({self.exc_type}: {self.message})"

@@ -47,6 +47,11 @@ class TaskSpec:
     max_restarts: int = 0
     max_concurrency: int = 1
     actor_name: str | None = None
+    # named concurrency group this actor call executes in (ref:
+    # transport/concurrency_group_manager.cc); None = default pool
+    concurrency_group: str | None = None
+    # {"group": max_concurrency} declared at actor creation
+    concurrency_groups: dict[str, int] | None = None
     # owner (submitter) — answers "who owns the returns"
     owner_address: tuple[str, int] | None = None
     # scheduling

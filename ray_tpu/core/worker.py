@@ -32,17 +32,96 @@ logger = logging.getLogger(__name__)
 from ray_tpu.core.task_error import TaskError
 
 
-class ActorRuntime:
-    """One hosted actor instance + its ordered execution lane."""
+class _Cancelled(BaseException):
+    """Injected into a running task's thread by ray_tpu.cancel (via
+    PyThreadState_SetAsyncExc). BaseException so bare `except Exception`
+    user code can't swallow it (KeyboardInterrupt-style semantics,
+    ref: _private/worker.py cancel → KeyboardInterrupt)."""
 
-    def __init__(self, actor_id: bytes, instance: Any, max_concurrency: int):
+
+class _CancellableExecutor:
+    """Fixed-size thread lane pool whose threads survive stray async
+    exceptions. PyThreadState_SetAsyncExc delivery is asynchronous: a
+    cancel that races task completion can fire between work items — inside
+    a stock ThreadPoolExecutor that lands in queue.get and silently kills
+    the thread (it is never respawned). Here the worker loop absorbs any
+    BaseException raised outside an item and keeps serving."""
+
+    def __init__(self, max_workers: int, thread_name_prefix: str = "lane"):
+        import queue
+
+        self._q: queue.Queue = queue.Queue()
+        self._threads = [
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"{thread_name_prefix}-{i}")
+            for i in range(max(1, max_workers))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _loop(self):
+        while True:
+            try:
+                fn, fut = self._q.get()
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(fn())
+                except BaseException as e:  # noqa: BLE001
+                    fut.set_exception(e)
+            except BaseException:  # noqa: BLE001
+                # Stray late _Cancelled between items: absorb, keep serving.
+                continue
+
+    def submit(self, fn, *args, **kwargs):
+        fut = concurrent.futures.Future()
+        self._q.put(((lambda: fn(*args, **kwargs)), fut))
+        return fut
+
+
+class ActorRuntime:
+    """One hosted actor instance + its execution lanes.
+
+    - Sync methods run on named concurrency-group thread pools (ref:
+      transport/concurrency_group_manager.cc — a "_default" pool of
+      max_concurrency plus one pool per declared group).
+    - `async def` methods run on a dedicated asyncio loop thread, bounded by
+      a semaphore of max_concurrency (ref: core_worker/fiber.h async actors).
+    """
+
+    def __init__(self, actor_id: bytes, instance: Any, max_concurrency: int,
+                 concurrency_groups: dict[str, int] | None = None):
         self.actor_id = actor_id
         self.instance = instance
-        self.pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=max(1, max_concurrency),
-            thread_name_prefix=f"actor-{ActorID(actor_id).hex()[:8]}",
-        )
+        prefix = f"actor-{ActorID(actor_id).hex()[:8]}"
+        self.pools = {
+            "_default": _CancellableExecutor(
+                max(1, max_concurrency), thread_name_prefix=prefix)
+        }
+        for group, n in (concurrency_groups or {}).items():
+            self.pools[group] = _CancellableExecutor(
+                max(1, int(n)), thread_name_prefix=f"{prefix}-{group}")
         self.max_concurrency = max_concurrency
+        self._aloop: asyncio.AbstractEventLoop | None = None
+        self._asem: asyncio.Semaphore | None = None
+
+    def pool_for(self, method, spec) -> concurrent.futures.ThreadPoolExecutor:
+        group = spec.concurrency_group or getattr(
+            method, "__ray_tpu_method_opts__", {}).get("concurrency_group")
+        return self.pools.get(group or "_default", self.pools["_default"])
+
+    def async_loop(self) -> asyncio.AbstractEventLoop:
+        """Lazily start the actor's event loop thread (async actors)."""
+        if self._aloop is None:
+            loop = asyncio.new_event_loop()
+            threading.Thread(target=loop.run_forever, daemon=True,
+                             name=f"actor-aio-{ActorID(self.actor_id).hex()[:8]}"
+                             ).start()
+            # asyncio.Semaphore is loop-agnostic at construction (3.10+);
+            # it is only ever awaited on `loop`.
+            self._asem = asyncio.Semaphore(max(1, self.max_concurrency))
+            self._aloop = loop
+        return self._aloop
 
 
 class Worker:
@@ -65,15 +144,16 @@ class Worker:
         self.raylet: rpc.Connection | None = None
         self.gcs: rpc.Connection | None = None
         self.actors: dict[bytes, ActorRuntime] = {}
-        self.task_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="task"
-        )
+        self.task_pool = _CancellableExecutor(1, thread_name_prefix="task")
         self.loop: asyncio.AbstractEventLoop | None = None
         self.address: tuple[str, int] | None = None
         self._exit = asyncio.Event()
         self.current_task_id: bytes | None = None
+        # task_id → ("thread", ident) | ("atask", asyncio.Task) for cancel
+        self._running: dict[bytes, tuple] = {}
         self.server.register("push_task", self._h_push_task)
         self.server.register("kill_actor", self._h_kill_actor)
+        self.server.register("cancel_task", self._h_cancel_task)
         self.server.register("ping", self._h_ping)
 
     async def start(self) -> None:
@@ -126,6 +206,33 @@ class Worker:
     async def _h_ping(self, conn, p):
         return {"ok": True, "actors": [a.hex() for a in self.actors]}
 
+    async def _h_cancel_task(self, conn, p):
+        """Cancel a running task (ref: CoreWorker::HandleCancelTask).
+        Cooperative: an async exception lands in the executing thread (or
+        the asyncio task is cancelled). force=True kills the process."""
+        if p.get("force"):
+            asyncio.get_running_loop().call_later(0.05, os._exit, 1)
+            return {"ok": True, "forced": True}
+        entry = self._running.get(p["task_id"])
+        if entry is None:
+            return {"ok": False, "running": False}
+        kind, target = entry
+        if kind == "thread":
+            import ctypes
+
+            # Narrow race: the task can complete between this check and the
+            # delivery (async-exc lands at the next bytecode). A stray
+            # _Cancelled outside an item is absorbed by
+            # _CancellableExecutor, so the worst case is a spurious
+            # TaskCancelledError on the task, never a dead lane thread.
+            if p["task_id"] not in self._running:
+                return {"ok": False, "running": False}
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(target), ctypes.py_object(_Cancelled))
+            return {"ok": n == 1, "running": True}
+        target.get_loop().call_soon_threadsafe(target.cancel)
+        return {"ok": True, "running": True}
+
     async def _h_kill_actor(self, conn, p):
         rt = self.actors.get(p["actor_id"])
         if rt is None:
@@ -168,18 +275,26 @@ class Worker:
             rt = self.actors.get(spec.actor_id)
             if rt is None:
                 return {"status": "actor_missing"}
-            fut = asyncio.get_running_loop().run_in_executor(
-                rt.pool, self._run_actor_task, rt, spec
-            )
+            method = getattr(rt.instance, spec.method_name, None)
+            if asyncio.iscoroutinefunction(method):
+                # async actor: run on the actor's event loop, bounded by
+                # the concurrency semaphore (ref: core_worker/fiber.h).
+                results, error = await self._run_async_actor_task(rt, spec)
+            else:
+                fut = asyncio.get_running_loop().run_in_executor(
+                    rt.pool_for(method, spec), self._run_actor_task, rt, spec
+                )
+                results, error = await fut
         elif spec.kind == ACTOR_CREATION:
             fut = asyncio.get_running_loop().run_in_executor(
                 self.task_pool, self._run_actor_creation, spec
             )
+            results, error = await fut
         else:
             fut = asyncio.get_running_loop().run_in_executor(
                 self.task_pool, self._run_normal_task, spec
             )
-        results, error = await fut
+            results, error = await fut
         profiling.record_event(
             spec.method_name or spec.name, spec.kind, _t0, time.time() - _t0,
             pid=f"node:{self.node_id.hex()[:8]}",
@@ -233,6 +348,7 @@ class Worker:
 
     def _run_normal_task(self, spec: TaskSpec):
         self.current_task_id = spec.task_id
+        self._running[spec.task_id] = ("thread", threading.get_ident())
         restore = None
         try:
             from ray_tpu.core.runtime_env import apply_runtime_env
@@ -242,6 +358,9 @@ class Worker:
             args, kwargs = self._resolve_args(spec)
             out = fn(*args, **kwargs)
             return self._split_returns(spec, out), None
+        except _Cancelled as e:
+            err = TaskError("TaskCancelledError", str(e) or "cancelled", "")
+            return [err] * max(1, spec.num_returns), err
         except Exception as e:
             err = TaskError(type(e).__name__, str(e), traceback.format_exc())
             return [err] * max(1, spec.num_returns), err
@@ -250,6 +369,7 @@ class Worker:
             if restore is not None:
                 restore()
             self.current_task_id = None
+            self._running.pop(spec.task_id, None)
 
     def _run_actor_creation(self, spec: TaskSpec):
         try:
@@ -259,7 +379,8 @@ class Worker:
             cls = serialization.unpack(spec.fn_blob)
             args, kwargs = self._resolve_args(spec)
             instance = cls(*args, **kwargs)
-            rt = ActorRuntime(spec.actor_id, instance, spec.max_concurrency)
+            rt = ActorRuntime(spec.actor_id, instance, spec.max_concurrency,
+                              spec.concurrency_groups)
             self.actors[spec.actor_id] = rt
             return [None], None
         except Exception as e:
@@ -268,16 +389,64 @@ class Worker:
 
     def _run_actor_task(self, rt: ActorRuntime, spec: TaskSpec):
         self.current_task_id = spec.task_id
+        self._running[spec.task_id] = ("thread", threading.get_ident())
         try:
             method = getattr(rt.instance, spec.method_name)
             args, kwargs = self._resolve_args(spec)
             out = method(*args, **kwargs)
             return self._split_returns(spec, out), None
+        except _Cancelled as e:
+            err = TaskError("TaskCancelledError", str(e) or "cancelled", "")
+            return [err] * max(1, spec.num_returns), err
         except Exception as e:
             err = TaskError(type(e).__name__, str(e), traceback.format_exc())
             return [err] * max(1, spec.num_returns), err
         finally:
             self.current_task_id = None
+            self._running.pop(spec.task_id, None)
+
+    async def _run_async_actor_task(self, rt: ActorRuntime, spec: TaskSpec):
+        """Async actor call: args resolve off-loop, the coroutine runs on
+        the actor's event loop under the concurrency semaphore; cancellation
+        maps to asyncio task cancellation."""
+        import concurrent.futures as _cf
+
+        method = getattr(rt.instance, spec.method_name)
+        try:
+            args, kwargs = await asyncio.to_thread(self._resolve_args, spec)
+        except Exception as e:
+            err = TaskError(type(e).__name__, str(e), traceback.format_exc())
+            return [err] * max(1, spec.num_returns), err
+        loop = rt.async_loop()
+        done: _cf.Future = _cf.Future()
+
+        async def runner():
+            async with rt._asem:
+                return await method(*args, **kwargs)
+
+        def schedule():
+            t = loop.create_task(runner())
+            self._running[spec.task_id] = ("atask", t)
+            def _finish(task):
+                self._running.pop(spec.task_id, None)
+                if task.cancelled():
+                    done.set_exception(asyncio.CancelledError())
+                elif task.exception() is not None:
+                    done.set_exception(task.exception())
+                else:
+                    done.set_result(task.result())
+            t.add_done_callback(_finish)
+
+        loop.call_soon_threadsafe(schedule)
+        try:
+            out = await asyncio.wrap_future(done)
+            return self._split_returns(spec, out), None
+        except asyncio.CancelledError:
+            err = TaskError("TaskCancelledError", "cancelled", "")
+            return [err] * max(1, spec.num_returns), err
+        except Exception as e:
+            err = TaskError(type(e).__name__, str(e), traceback.format_exc())
+            return [err] * max(1, spec.num_returns), err
 
     @staticmethod
     def _split_returns(spec: TaskSpec, out: Any) -> list:

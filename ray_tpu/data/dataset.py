@@ -59,6 +59,15 @@ def _map_block_task(fn_packed, blk):
     return fn(blk)
 
 
+_LAST_STAGE_STATS: dict = {}
+
+
+def last_stage_stats() -> dict:
+    """Per-stage stats of the most recent all-to-all executions (shuffle
+    rounds, task counts, wall time) — the reference's DatasetStats analog."""
+    return dict(_LAST_STAGE_STATS)
+
+
 class Dataset:
     def __init__(self, block_refs: list, stages: list | None = None):
         self._block_refs = list(block_refs)
@@ -147,11 +156,12 @@ class Dataset:
         return self._with_stage(AllToAllStage("repartition", do))
 
     def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
-        """Two-phase map→reduce shuffle
-        (ref: _internal/push_based_shuffle.py:22 / shuffle_and_partition.py)."""
+        """Pipelined push-based shuffle
+        (ref: _internal/push_based_shuffle.py:22); per-stage stats land in
+        `ray_tpu.data.dataset.last_stage_stats()`."""
 
         def do(refs):
-            return _shuffle(refs, seed)
+            return _shuffle(refs, seed, stats_sink=_LAST_STAGE_STATS)
 
         return self._with_stage(AllToAllStage("random_shuffle", do))
 
@@ -504,19 +514,21 @@ def _repartition(refs: list, num_blocks: int) -> list:
     ]
 
 
-def _shuffle(refs: list, seed: int | None) -> list:
+def _shuffle(refs: list, seed: int | None, stats_sink: dict | None = None) -> list:
+    """Push-based two-phase shuffle (ref: push_based_shuffle.py:22):
+    pipelined map rounds → node-affine merges → per-partition row shuffle."""
+    from ray_tpu.data.shuffle import ShuffleStats, push_based_shuffle
+
     n = max(1, len(refs))
     seeds = np.random.default_rng(seed).integers(0, 2**31, len(refs) + n)
-    parts = [
-        _partition_task.options(num_returns=n).remote(r, n, int(s))
-        for r, s in zip(refs, seeds[: len(refs)])
-    ]
-    if n == 1:
-        parts = [[p] if not isinstance(p, list) else p for p in parts]
-    merged = []
-    for j in range(n):
-        col = [parts[i][j] for i in range(len(refs))]
-        merged.append(_merge_task.remote(*col))
+    st = ShuffleStats()
+    merged = push_based_shuffle(
+        refs, n, _partition_task, _merge_task,
+        partition_args=lambda i, r: (r, n, int(seeds[i])),
+        stats=st,
+    )
+    if stats_sink is not None:
+        stats_sink["random_shuffle"] = st.summary()
     return [
         _shuffle_rows_task.remote(m, int(s))
         for m, s in zip(merged, seeds[len(refs):])
@@ -540,15 +552,13 @@ def _sort(refs: list, key: str | None, descending: bool) -> list:
             samples[int(len(samples) * (i + 1) / n)]
             for i in range(n - 1)
         ] if len(samples) else []
-        parts = [
-            _range_partition_task.options(num_returns=n).remote(r, key, bounds)
-            for r in sorted_refs
-        ]
-        out = []
-        for j in range(n):
-            col = [parts[i][j] for i in range(n)]
-            merged = _merge_task.remote(*col)
-            out.append(_sort_block_task.remote(merged, key, False))
+        from ray_tpu.data.shuffle import push_based_shuffle
+
+        merged = push_based_shuffle(
+            sorted_refs, n, _range_partition_task, _merge_task,
+            partition_args=lambda i, r: (r, key, bounds),
+        )
+        out = [_sort_block_task.remote(m, key, False) for m in merged]
     if descending:
         out = [_sort_block_task.remote(r, key, True) for r in reversed(out)]
     return out

@@ -75,7 +75,11 @@ class GPTConfig:
         kw.setdefault("vocab_size", 256)
         kw.setdefault("max_seq", 128)
         kw.setdefault("rotary_dim", 4)
-        return cls(d_model=64, n_layers=2, n_heads=8, d_ff=128, **kw)
+        kw.setdefault("d_model", 64)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 8)
+        kw.setdefault("d_ff", 128)
+        return cls(**kw)
 
     @classmethod
     def tiny_untied(cls, **kw) -> "GPTConfig":
@@ -260,6 +264,49 @@ def forward(
         preferred_element_type=jnp.float32,
     )
     return logits
+
+
+def forward_pipeline(
+    params: dict[str, jax.Array],
+    tokens: jax.Array,
+    cfg: GPTConfig,
+    mesh,
+    n_micro: int,
+) -> jax.Array:
+    """Pipeline-parallel forward: the scanned block stack shards over the
+    `pp` mesh axis and runs the GPipe microbatch schedule
+    (parallel/pipeline.py); embedding, final norm, and head stay outside
+    the pipeline (replicated over pp, sharded by the usual fsdp/tp rules).
+    Requires cfg.n_layers % mesh.shape['pp'] == 0."""
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+
+    def stage(local_stack, act):
+        def body(a, layer):
+            fn = (jax.checkpoint(lambda aa, ll: _block(aa, ll, cfg))
+                  if cfg.remat else (lambda aa, ll: _block(aa, ll, cfg)))
+            return fn(a, layer), None
+
+        a, _ = jax.lax.scan(body, act, local_stack)
+        return a
+
+    x = pipeline_apply(stage, stacked, x, mesh=mesh, n_micro=n_micro)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    head = params["lm_head"] if not cfg.tie_embeddings else params["wte"].T
+    return jnp.einsum(
+        "bsd,dv->bsv", x, head.astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def pipeline_loss_fn(params, tokens, targets, cfg: GPTConfig, mesh,
+                     n_micro: int) -> jax.Array:
+    logits = forward_pipeline(params, tokens, cfg, mesh, n_micro)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
 
 
 def loss_fn(

@@ -1,0 +1,145 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Net-new capability (SURVEY §2.4 expert-parallelism row: ❌ in the
+reference). GShard/Switch-style top-2 token-choice routing with capacity:
+
+    gates = softmax(x @ wg)            [tokens, E]
+    top-2 experts per token, renormalized; tokens beyond an expert's
+    capacity C are dropped (their combine weight is 0 → residual passthrough
+    at the call site).
+    dispatch [G, E, C] one-hot  → expert inputs  [E, C, D]  (einsum)
+    expert MLP (stacked weights [E, D, F] / [E, F, D])
+    combine  [G, E, C] weighted → outputs        [G, D]     (einsum)
+
+TPU-first: everything is dense einsum under jit — the expert axis carries
+the logical "expert" sharding (→ `ep` mesh axis, parallel/mesh.py), so
+XLA partitions expert compute across `ep` and derives the token all-to-all
+from the dispatch/combine einsums' shardings; no hand-written a2a.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    n_experts: int
+    capacity_factor: float = 1.5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def capacity(self, n_tokens: int) -> int:
+        # top-2 routing: each token lands in up to 2 experts.
+        return max(1, math.ceil(
+            2 * n_tokens / self.n_experts * self.capacity_factor))
+
+
+def moe_param_specs(cfg: MoEConfig) -> dict[str, dict[str, Any]]:
+    D, F, E = cfg.d_model, cfg.d_ff, cfg.n_experts
+    return {
+        "wg": {"shape": (D, E), "axes": ("embed", None),
+               "init": "normal", "scale": 0.02},
+        "w_up": {"shape": (E, D, F), "axes": ("expert", "embed", "mlp"),
+                 "init": "normal", "scale": 0.02},
+        "b_up": {"shape": (E, F), "axes": ("expert", "mlp"),
+                 "init": "zeros"},
+        "w_down": {"shape": (E, F, D), "axes": ("expert", "mlp", "embed"),
+                   "init": "normal", "scale": 0.02},
+        "b_down": {"shape": (E, D), "axes": ("expert", "embed"),
+                   "init": "zeros"},
+    }
+
+
+def init_moe_params(cfg: MoEConfig, rng: jax.Array) -> dict[str, jax.Array]:
+    specs = moe_param_specs(cfg)
+    keys = jax.random.split(rng, len(specs))
+    out = {}
+    for key, (name, s) in zip(keys, sorted(specs.items())):
+        if s["init"] == "normal":
+            out[name] = jax.random.normal(
+                key, s["shape"], cfg.param_dtype) * s["scale"]
+        else:
+            out[name] = jnp.zeros(s["shape"], cfg.param_dtype)
+    return out
+
+
+def moe_logical_axes(cfg: MoEConfig) -> dict[str, tuple]:
+    return {k: v["axes"] for k, v in moe_param_specs(cfg).items()}
+
+
+def _top2_dispatch(gates: jax.Array, capacity: int):
+    """gates [G, E] fp32 → (dispatch [G, E, C] bool-ish, combine [G, E, C]).
+
+    Classic GShard construction: per-expert arrival order via cumsum of the
+    one-hot assignment; tokens whose slot ≥ capacity are dropped.
+    """
+    G, E = gates.shape
+    idx1 = jnp.argmax(gates, axis=-1)                       # [G]
+    mask1 = jax.nn.one_hot(idx1, E, dtype=gates.dtype)      # [G, E]
+    gates2 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=gates.dtype)
+
+    w1 = jnp.sum(gates * mask1, axis=-1)
+    w2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    # Slot index = arrival position within the expert (top-1 routes fill
+    # before top-2 routes, matching GShard).
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1                # [G, E]
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0)
+    slot1 = jnp.sum(pos1 * mask1, axis=-1)                  # [G]
+    slot2 = jnp.sum(pos2 * mask2, axis=-1)
+    keep1 = slot1 < capacity
+    keep2 = slot2 < capacity
+
+    oh_slot1 = jax.nn.one_hot(slot1, capacity, dtype=gates.dtype)
+    oh_slot2 = jax.nn.one_hot(slot2, capacity, dtype=gates.dtype)
+    d1 = mask1[:, :, None] * oh_slot1[:, None, :] * keep1[:, None, None]
+    d2 = mask2[:, :, None] * oh_slot2[:, None, :] * keep2[:, None, None]
+    dispatch = d1 + d2                                      # [G, E, C]
+    combine = d1 * w1[:, None, None] + d2 * w2[:, None, None]
+    return dispatch, combine
+
+
+def moe_mlp(x: jax.Array, params: dict[str, jax.Array],
+            cfg: MoEConfig) -> tuple[jax.Array, jax.Array]:
+    """x [B, S, D] → (y [B, S, D], aux_loss scalar).
+
+    aux_loss is the standard load-balancing loss (mean fraction routed ×
+    mean gate prob per expert × E) — add `aux * coef` to the model loss.
+    """
+    B, S, D = x.shape
+    G = B * S
+    xf = x.reshape(G, D)
+    gates = jax.nn.softmax(
+        jnp.einsum("gd,de->ge", xf.astype(jnp.float32),
+                   params["wg"].astype(jnp.float32)), axis=-1)
+    C = cfg.capacity(G)
+    dispatch, combine = _top2_dispatch(gates, C)
+    # Token → expert slots (XLA turns the resharding from token-sharded xf
+    # to expert-sharded slots into the a2a).
+    expert_in = jnp.einsum(
+        "gec,gd->ecd", dispatch.astype(cfg.dtype), xf.astype(cfg.dtype))
+    up = jnp.einsum("ecd,edf->ecf", expert_in,
+                    params["w_up"].astype(cfg.dtype))
+    up = jax.nn.gelu(up + params["b_up"].astype(cfg.dtype)[:, None, :])
+    down = jnp.einsum("ecf,efd->ecd", up,
+                      params["w_down"].astype(cfg.dtype))
+    down = down + params["b_down"].astype(cfg.dtype)[:, None, :]
+    y = jnp.einsum("gec,ecd->gd", combine.astype(cfg.dtype), down)
+    # Load-balance aux loss (Switch Transformer eq. 4).
+    frac_routed = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(gates, -1), cfg.n_experts), axis=0)
+    mean_gate = jnp.mean(gates, axis=0)
+    aux = cfg.n_experts * jnp.sum(frac_routed * mean_gate)
+    return y.reshape(B, S, D), aux

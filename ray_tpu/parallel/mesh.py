@@ -9,12 +9,17 @@ ICI/DCN collectives.
 Axis convention (order matters — outermost axis maps to the slowest-varying
 device dimension, which on multi-host TPU should be the DCN dimension):
 
-    ("dp", "fsdp", "sp", "tp")
+    ("dp", "pp", "fsdp", "sp", "ep", "tp")
 
 - dp:   pure data parallelism (gradient all-reduce; rides DCN across slices)
+- pp:   pipeline parallelism (GPipe microbatch schedule over ppermute;
+        stage-to-stage sends tolerate DCN latency, so pp sits outside the
+        ICI-hungry axes — see parallel/pipeline.py)
 - fsdp: data parallelism with sharded parameters/optimizer (ZeRO-3 style;
         all-gather weights / reduce-scatter grads over ICI)
 - sp:   sequence/context parallelism (ring attention sends KV blocks over ICI)
+- ep:   expert (MoE) parallelism — experts sharded, token dispatch is an
+        all-to-all XLA derives from the shardings (see ops/moe.py)
 - tp:   tensor (megatron-style) parallelism; innermost so its collectives ride
         the fastest ICI loops
 """
@@ -30,7 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 # Canonical axis order, outermost (slowest / DCN) first.
-MESH_AXES: tuple[str, ...] = ("dp", "fsdp", "sp", "tp")
+MESH_AXES: tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "ep", "tp")
 
 # Logical model axes → mesh axes. Anything not listed is replicated.
 # This is the single source of truth used by sharding.logical_to_spec.
@@ -43,7 +48,14 @@ DEFAULT_LOGICAL_RULES: tuple[tuple[str, Any], ...] = (
     ("kv", None),
     ("vocab", "tp"),
     ("layers", None),            # stacked-layer leading axis (scanned)
-    ("expert", "tp"),
+    ("expert", "ep"),            # MoE experts sharded over ep
+)
+
+# Pipeline variant: the stacked-layer axis shards over pp — each stage holds
+# n_layers/pp blocks (used by spmd.build_pipeline_training).
+PIPELINE_LOGICAL_RULES: tuple[tuple[str, Any], ...] = tuple(
+    (name, "pp") if name == "layers" else (name, ax)
+    for name, ax in DEFAULT_LOGICAL_RULES
 )
 
 
@@ -55,9 +67,12 @@ class MeshConfig:
     fsdp: int = -1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
+    ep: int = 1
 
     def resolve(self, n_devices: int) -> dict[str, int]:
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+        sizes = {"dp": self.dp, "pp": self.pp, "fsdp": self.fsdp,
+                 "sp": self.sp, "ep": self.ep, "tp": self.tp}
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one mesh axis may be -1, got {wild}")

@@ -1,0 +1,103 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a `pp` mesh axis.
+
+Net-new capability (the reference has none — SURVEY §2.4 pipeline row: ❌).
+TPU-first design: the pipeline is ONE jitted program. The stacked-layer
+axis of the transformer shards over `pp` (each stage holds n_layers/pp
+blocks); inside a partial-manual `jax.shard_map` (only `pp` is manual, so
+fsdp/tp/sp shardings keep flowing through XLA's auto partitioner) a
+`lax.scan` steps the classic GPipe schedule:
+
+    step t: stage r processes microbatch (t - r); activations rotate to the
+    next stage with `lax.ppermute`.
+
+Because scan/ppermute/where are differentiable, `jax.grad` through this
+function IS pipeline-parallel backprop — no hand-written backward schedule
+(1F1B etc. are manual-scheduling answers to a problem XLA's remat +
+reverse-mode already solve here).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_micro: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run `x` [B, ...] through a layer stack pipelined over `axis`.
+
+    - `stacked_params`: pytree whose leaves have a leading layers axis,
+      SHARDED over `axis` (each stage sees n_layers/pp local layers).
+    - `stage_fn(local_stacked, activation) -> activation` applies one
+      stage's layers (typically an inner lax.scan over the local stack).
+    - `n_micro`: microbatch count; B % n_micro == 0. More microbatches →
+      smaller pipeline bubble (bubble fraction = (pp-1)/(pp-1+n_micro)).
+    """
+    pp = mesh.shape[axis]
+    if pp == 1:
+        return stage_fn(stacked_params, x)
+    B = x.shape[0]
+    assert B % n_micro == 0, (B, n_micro)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        axis_names={axis},
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run(local_stack, x_full):
+        r = lax.axis_index(axis)
+        mb = B // n_micro
+        xm = x_full.reshape((n_micro, mb) + x_full.shape[1:])
+        out = jnp.zeros_like(xm)
+        act = jnp.zeros_like(xm[0])
+        fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def step(carry, t):
+            act_in, acc = carry
+            # Stage 0 ingests microbatch t; later stages consume the
+            # activation handed over by the previous stage.
+            inp = jnp.where(r == 0, xm[jnp.clip(t, 0, n_micro - 1)], act_in)
+            y = stage_fn(local_stack, inp)
+            nxt = lax.ppermute(y, axis, fwd)
+            out_idx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
+            write = jnp.logical_and(t - (pp - 1) >= 0, r == pp - 1)
+            acc = jnp.where(write, acc.at[out_idx].set(y), acc)
+            return (nxt, acc), None
+
+        (_, out), _ = lax.scan(
+            step, (act, out), jnp.arange(n_micro + pp - 1))
+        # Only the last stage holds real outputs; psum over pp broadcasts
+        # them to every stage (zeros elsewhere). In f32: XLA CPU's
+        # AllReducePromotion pass aborts on bf16 all-reduces emitted from
+        # partial-manual regions (hard crash, not an error).
+        out = lax.psum(
+            jnp.where(r == pp - 1, out, jnp.zeros_like(out))
+            .astype(jnp.float32), axis).astype(x_full.dtype)
+        return out.reshape(x_full.shape)
+
+    return run(stacked_params, x)
+
+
+def split_microbatch_count(batch: int, pp: int, target: int | None = None) -> int:
+    """Pick a microbatch count: ≥2·pp when possible (keeps the bubble
+    under ~33%), dividing the batch."""
+    want = target or max(2 * pp, 1)
+    for m in range(min(want, batch), 0, -1):
+        if batch % m == 0:
+            return m
+    return 1

@@ -123,3 +123,43 @@ def partial_loss(cfg, mesh=None):
         return gpt.loss_fn(params, tokens, targets, cfg, mesh)
 
     return loss
+
+
+def build_pipeline_training(
+    cfg,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    rng: jax.Array,
+    *,
+    n_micro: int | None = None,
+):
+    """Pipeline-parallel variant of build_training: the layer stack shards
+    over the mesh's `pp` axis (PIPELINE_LOGICAL_RULES) and the train step
+    differentiates straight through the GPipe schedule
+    (parallel/pipeline.py). Composes with dp/fsdp/tp via the same logical
+    rules — those axes stay under XLA's auto partitioner."""
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel.mesh import PIPELINE_LOGICAL_RULES
+    from ray_tpu.parallel.pipeline import split_microbatch_count
+
+    pp = mesh.shape.get("pp", 1)
+    if cfg.n_layers % max(pp, 1) != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    rules = PIPELINE_LOGICAL_RULES
+    logical = gpt.logical_axes(cfg)
+    params, p_shard = sharded_init(
+        partial(gpt.init_params, cfg), logical, mesh, rng, rules
+    )
+    o_shard = opt_state_shardings(optimizer, params, p_shard)
+    opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
+
+    def loss(params, tokens, targets):
+        m = n_micro or split_microbatch_count(tokens.shape[0], pp)
+        return gpt.pipeline_loss_fn(params, tokens, targets, cfg, mesh, m)
+
+    step_fn = make_train_step(
+        loss, optimizer, mesh, p_shard, o_shard,
+        batch_spec=PartitionSpec(("dp", "fsdp")),
+    )
+    return params, opt_state, step_fn

@@ -169,3 +169,19 @@ def test_union(cluster):
     a = rd.range(10)
     b = rd.range(5)
     assert a.union(b).count() == 15
+
+
+def test_push_shuffle_multinode_with_stats(cluster):
+    """Push-based shuffle (VERDICT r1 item 7): pipelined rounds with
+    per-stage stats; correctness across a shuffle + sort."""
+    from ray_tpu.data.dataset import last_stage_stats
+
+    ds = rd.range(500).random_shuffle(seed=7)
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == list(range(500))
+    stats = last_stage_stats().get("random_shuffle")
+    assert stats and stats["map_tasks"] > 0 and stats["merge_tasks"] > 0
+    assert stats["rounds"] >= 1
+
+    out = rd.range(300).random_shuffle(seed=1).sort("id").take_all()
+    assert [r["id"] for r in out] == list(range(300))

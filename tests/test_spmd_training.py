@@ -20,7 +20,7 @@ from ray_tpu.train import spmd
 
 def test_mesh_config_resolve():
     assert MeshConfig(dp=2, fsdp=-1, tp=2).resolve(8) == {
-        "dp": 2, "fsdp": 2, "sp": 1, "tp": 2,
+        "dp": 2, "pp": 1, "fsdp": 2, "sp": 1, "ep": 1, "tp": 2,
     }
     assert MeshConfig().resolve(8)["fsdp"] == 8
     with pytest.raises(ValueError):
@@ -29,7 +29,8 @@ def test_mesh_config_resolve():
 
 def test_make_mesh_shapes(cpu_devices):
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2))
-    assert mesh.shape == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+    assert mesh.shape == {"dp": 2, "pp": 1, "fsdp": 2, "sp": 1,
+                          "ep": 1, "tp": 2}
     mesh = make_mesh({"tp": 8})
     assert mesh.shape["tp"] == 8
 
