@@ -162,6 +162,11 @@ def init(
         from ray_tpu.core.client import CoreClient
 
         config = Config.from_env().override(_system_config)
+        if address is not None and address.startswith("ray://"):
+            # Remote driver: connect from outside the cluster; object data
+            # travels over RPC instead of the same-host shm arena.
+            address = address[len("ray://"):]
+            config.remote_object_plane = True
         if object_store_memory is not None:
             config.object_store_memory = object_store_memory
         if address is None:

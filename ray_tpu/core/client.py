@@ -149,6 +149,11 @@ class CoreClient:
 
         self.refcounter = ReferenceCounter(self)
         self._run(self.gcs.call("subscribe", {"channels": ["actor"]}))
+        # Drivers (not workers) print streamed task/actor output
+        # (ref: worker.py:1672 print_logs — the "(worker ...)" lines).
+        if (self.config.log_to_driver
+                and not os.environ.get("RAY_TPU_WORKER_ID")):
+            self.subscribe_channel("logs", self._print_worker_logs)
         if self.config.ref_counting_enabled:
             self._run(self.gcs.call("ref_register_holder", {
                 "holder_id": self.refcounter.holder_id, "held": [],
@@ -188,6 +193,14 @@ class CoreClient:
         )
         await conn._ensure()
         return conn
+
+    @staticmethod
+    def _print_worker_logs(payload) -> None:
+        import sys
+
+        prefix = f"({payload['worker'][:8]}, node={payload['node']})"
+        for line in payload.get("lines", ()):
+            print(f"{prefix} {line}", file=sys.stderr)
 
     def subscribe_channel(self, channel: str, callback) -> None:
         """Register a pubsub callback for `pub:<channel>` notifies from the
@@ -341,12 +354,19 @@ class CoreClient:
 
     async def _store_serialized(self, oid: bytes, head: bytes, views) -> None:
         """Write a serialized value into the node store under `oid`:
-        inline below the cutoff, zero-copy extent write + seal above."""
+        inline below the cutoff, zero-copy extent write + seal above. Remote
+        drivers (ray://) can't mmap the arena — data rides the RPC."""
         size = serialization.serialized_size(head, views)
         if size <= self.config.max_inline_object_size:
             data = bytearray(size)
             serialization.write_to(memoryview(data), head, views)
             await self.raylet.call("store_put_inline", {
+                "object_id": oid, "data": bytes(data),
+            })
+        elif self.config.remote_object_plane:
+            data = bytearray(size)
+            serialization.write_to(memoryview(data), head, views)
+            await self.raylet.call("store_put_data", {
                 "object_id": oid, "data": bytes(data),
             })
         else:
@@ -392,6 +412,7 @@ class CoreClient:
             resolved = self._run(self.raylet.call("store_get", {
                 "object_ids": [k for _, k in missing],
                 "timeout": chunk,
+                "want_data": self.config.remote_object_plane,
             }), timeout=chunk + 30)
             still: list[tuple[int, bytes]] = []
             for (i, key), (loc, data) in zip(missing, resolved):

@@ -108,6 +108,17 @@ class Config:
     # gcs_failover_worker_reconnect_timeout).
     gcs_reconnect_window_s: float = 60.0
 
+    # Remote driver ("ray://") mode: the client cannot mmap the node's
+    # /dev/shm arena, so object data rides the RPC connection instead
+    # (ref: util/client/ARCHITECTURE.md — here no proxy process is needed;
+    # the control plane is already plain TCP). Single-frame transfers:
+    # objects up to rpc_max_frame_bytes.
+    remote_object_plane: bool = False
+
+    # Stream worker stdout/stderr (user prints) to connected drivers
+    # (ref: _private/log_monitor.py:100 → driver prints).
+    log_to_driver: bool = True
+
     # --- GCS durability (ref: gcs/store_client/redis_store_client.h — the
     #     reference persists every table write to Redis; here a per-mutation
     #     WAL + periodic snapshot compaction) ---

@@ -115,6 +115,7 @@ class Raylet:
         s.register("store_create", self._h_store_create)
         s.register("store_seal", self._h_store_seal)
         s.register("store_put_inline", self._h_store_put_inline)
+        s.register("store_put_data", self._h_store_put_data)
         s.register("store_get", self._h_store_get)
         s.register("store_contains", self._h_store_contains)
         s.register("store_free", self._h_store_free)
@@ -167,6 +168,8 @@ class Raylet:
         asyncio.ensure_future(self._reap_idle_loop())
         if self.config.memory_monitor_period_s > 0:
             asyncio.ensure_future(self._memory_monitor_loop())
+        if self.config.log_to_driver:
+            asyncio.ensure_future(self._log_monitor_loop())
         for _ in range(self.config.prestart_workers):
             self._spawn_worker()
         logger.info(
@@ -320,6 +323,75 @@ class Raylet:
             for h in excess[: max(0, len(excess) - min_keep)]:
                 if h.conn is not None:
                     h.conn.notify("exit", {})
+
+    # ------------------------------------------------- log streaming
+    # (ref: _private/log_monitor.py:100 — tail worker logs, publish via GCS
+    #  pubsub so drivers print task/actor output live)
+
+    async def _log_monitor_loop(self) -> None:
+        offsets: dict[str, int] = {}
+        log_dir = os.path.join(self.session_dir, "logs")
+        node_hex = NodeID(self.node_id).hex()[:8]
+        while not self._shutdown:
+            await asyncio.sleep(0.5)
+            try:
+                names = [n for n in os.listdir(log_dir)
+                         if n.startswith("worker-")]
+            except OSError:
+                continue
+            for name in names:
+                path = os.path.join(log_dir, name)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                off = offsets.get(name, 0)
+                if size <= off:
+                    continue
+                window = 64 * 1024
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        chunk = f.read(window)
+                except OSError:
+                    continue
+                # Only ship complete lines; carry partials to the next tick.
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    if len(chunk) >= window:
+                        # A single line longer than the window would stall
+                        # the tail forever: force-advance and truncate it.
+                        offsets[name] = off + len(chunk)
+                        chunk = chunk + b"...[truncated]\n"
+                        cut = len(chunk) - 1
+                    else:
+                        continue
+                else:
+                    offsets[name] = off + cut + 1
+                lines = [
+                    ln for ln in
+                    chunk[:cut].decode("utf-8", "replace").split("\n")
+                    # framework chatter stays in the file; user prints stream
+                    if ln and not ln.startswith("[worker]")
+                ]
+                worker_hex = name[len("worker-"):-len(".log")]
+                # NOTE: the channel is cluster-scoped — with multiple
+                # concurrent drivers each sees all jobs' prints (the
+                # reference filters by job id; workers here are pooled
+                # across jobs, so per-job attribution needs worker-side
+                # tagging — future work).
+                for i in range(0, len(lines), 200):
+                    try:
+                        await self.gcs.call("publish", {
+                            "channel": "logs",
+                            "message": {
+                                "node": node_hex,
+                                "worker": worker_hex,
+                                "lines": lines[i:i + 200],
+                            },
+                        }, timeout=10.0)
+                    except Exception:
+                        break
 
     # ------------------------------------------------- memory protection
     # (ref: common/memory_monitor.h:48 UsageAboveThreshold +
@@ -706,11 +778,25 @@ class Raylet:
             self._announce_locations([p["object_id"]])
         return {"ok": True}
 
+    async def _h_store_put_data(self, conn, p):
+        """Remote-driver put: data arrives over RPC and is written into the
+        store daemon-side (no client mmap)."""
+        obj = ObjectID(p["object_id"])
+        data = p["data"]
+        await self.store.create(obj, len(data))
+        self.store.write_bytes(obj, 0, data)
+        self.store.seal(obj)
+        if not p.get("local_only"):
+            self._announce_locations([p["object_id"]])
+        return {"ok": True}
+
     async def _h_store_get(self, conn, p):
         """Resolve objects for a local client; pulls from remote if needed.
         Returns per-object: ("inline", bytes) | ("shm", (name, size)) |
-        ("missing", None)."""
+        ("missing", None). want_data=True (remote drivers) returns bytes
+        for shm entries instead of an arena descriptor."""
         timeout = p.get("timeout")
+        want_data = p.get("want_data", False)
         loop = asyncio.get_running_loop()
         deadline = None if timeout is None else loop.time() + timeout
         out = []
@@ -736,12 +822,27 @@ class Raylet:
                 # Pin: the client holds a zero-copy mmap view — the extent
                 # must not be spilled/moved under it. Released on explicit
                 # free by this client or when the connection drops.
+                if want_data:
+                    e = self.store.entries.get(obj)
+                    if e is not None and e.location == "spilled":
+                        # Serve straight from the spill file: restoring into
+                        # the arena just to copy bytes into the reply could
+                        # evict live objects under pressure.
+                        out.append(("inline",
+                                    self.store.read_bytes(obj, 0, e.size)))
+                        continue
                 try:
-                    loc, data = await self.store.describe(obj, pin=True)
+                    loc, data = await self.store.describe(obj,
+                                                          pin=not want_data)
                 except KeyError:  # freed concurrently with this get
                     out.append(("missing", None))
                     continue
                 if loc == "shm":
+                    if want_data:
+                        _arena, _off, size = data
+                        out.append(("inline",
+                                    self.store.read_bytes(obj, 0, size)))
+                        continue
                     key = (obj, self.store.entry_gen(obj))
                     pins = self._conn_pins.setdefault(id(conn), {})
                     pins[key] = pins.get(key, 0) + 1

@@ -143,6 +143,8 @@ def cmd_list(args) -> None:
         rows = state.list_nodes()
     elif args.kind == "actors":
         rows = state.list_actors()
+    elif args.kind == "tasks":
+        rows = state.list_tasks()
     else:
         from ray_tpu.job_submission import JobSubmissionClient
 
@@ -218,7 +220,7 @@ def main(argv: list[str] | None = None) -> None:
     sp.set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("list", help="list cluster state")
-    sp.add_argument("kind", choices=["nodes", "actors", "jobs"])
+    sp.add_argument("kind", choices=["nodes", "actors", "tasks", "jobs"])
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)
 

@@ -61,6 +61,41 @@ def list_actors(*, state: str | None = None) -> list[dict]:
     return out
 
 
+def list_tasks(limit: int = 200) -> list[dict]:
+    """Recent task executions aggregated from worker profile spans
+    (ref: dashboard/state_aggregator.py task rows + StatsGcsService
+    AddProfileData). Newest first: name, kind, node, worker, start,
+    duration."""
+    events = _call_gcs("profile_get") or []
+    rows = []
+    for ev in events:
+        rows.append({
+            "name": ev.get("name"),
+            "kind": ev.get("cat"),
+            "node": ev.get("pid"),
+            "worker": ev.get("tid"),
+            "start_ts": ev.get("ts"),
+            "duration_s": (ev.get("dur", 0) or 0) / 1e6,
+        })
+    rows.sort(key=lambda r: r.get("start_ts") or 0, reverse=True)
+    return rows[:limit]
+
+
+def summarize_tasks() -> dict:
+    """`ray summary tasks` analog: execution counts + total/mean runtime
+    per task name."""
+    agg: dict[str, dict] = {}
+    for r in list_tasks(limit=100000):
+        a = agg.setdefault(r["name"], {"name": r["name"], "count": 0,
+                                       "total_s": 0.0})
+        a["count"] += 1
+        a["total_s"] += r["duration_s"]
+    for a in agg.values():
+        a["mean_s"] = round(a["total_s"] / max(a["count"], 1), 4)
+        a["total_s"] = round(a["total_s"], 4)
+    return {"tasks": sorted(agg.values(), key=lambda a: -a["total_s"])}
+
+
 def object_store_stats() -> list[dict]:
     """Per-node shared-memory store stats (ray memory equivalent)."""
     nodes = list_nodes()

@@ -9,12 +9,26 @@ Platform forcing lives in ray_tpu.utils.platform (shared with bench.py and
 __graft_entry__.py) — it must run before any backend is initialized.
 """
 
+import os
+
 from ray_tpu.utils.platform import force_cpu_devices
 
 force_cpu_devices(8)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# Persistent XLA compilation cache: the compile-heavy train/spmd/ring tests
+# dominate suite wall time; repeat runs hit the cache instead of recompiling
+# (cache key includes program + platform, so it is safe across edits).
+_cache_dir = os.environ.get("RAY_TPU_TEST_JAX_CACHE",
+                            "/tmp/ray_tpu_jax_cache")
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Subprocesses (workers, multi-process train backends) inherit via env.
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 
 @pytest.fixture(scope="session")

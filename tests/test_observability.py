@@ -105,3 +105,20 @@ class TestTimeline:
                 r.read()
         finally:
             dash.stop()
+
+
+class TestTaskStateAggregation:
+    def test_list_and_summarize_tasks(self, cluster):
+        from ray_tpu import state
+
+        @ray_tpu.remote
+        def traced(i):
+            return i
+
+        ray_tpu.get([traced.remote(i) for i in range(5)], timeout=60)
+        time.sleep(2.0)  # worker profile flush tick
+        rows = state.list_tasks()
+        assert any(r["name"] == "traced" for r in rows), rows[:3]
+        summ = state.summarize_tasks()
+        named = {t["name"]: t for t in summ["tasks"]}
+        assert named.get("traced", {}).get("count", 0) >= 5

@@ -1,0 +1,95 @@
+"""Remote drivers ("Ray Client" parity) + driver log streaming.
+
+The reference needs a gRPC proxy (`util/client/ARCHITECTURE.md`) because its
+drivers must colocate with plasma. Here the control plane is already plain
+TCP, so a remote driver connects DIRECTLY to the GCS + a raylet — the only
+same-host dependency is the /dev/shm object plane, replaced in remote mode
+by an RPC object plane (`ray://` address scheme).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_remote_driver_over_rpc_object_plane():
+    """A driver in a separate process with NO access to the cluster's shm
+    arena (remote mode) runs tasks, puts/gets large objects, uses actors."""
+    cluster = Cluster(head_node_args={"num_cpus": 4})
+    try:
+        host, port = cluster.gcs_address
+        code = f"""
+import numpy as np
+import ray_tpu
+
+ray_tpu.init(address="ray://{host}:{port}")
+
+@ray_tpu.remote
+def double(x):
+    return x * 2
+
+# large object: forces the RPC object plane (no shm attach remotely)
+arr = np.arange(1 << 16, dtype=np.int64)
+ref = ray_tpu.put(arr)
+out = ray_tpu.get(double.remote(ref), timeout=120)
+assert int(out[5]) == 10, out[5]
+
+@ray_tpu.remote
+class Acc:
+    def __init__(self):
+        self.n = 0
+    def add(self, k):
+        self.n += k
+        return self.n
+
+a = Acc.remote()
+assert ray_tpu.get(a.add.remote(7), timeout=120) == 7
+assert ray_tpu.get(a.add.remote(5), timeout=120) == 12
+print("REMOTE-DRIVER-OK")
+"""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=300, env=env,
+        )
+        assert "REMOTE-DRIVER-OK" in out.stdout, (out.stdout, out.stderr[-2000:])
+    finally:
+        cluster.shutdown()
+
+
+def test_worker_prints_stream_to_driver():
+    """User print() inside a task reaches the driver's stderr
+    (ref: _private/log_monitor.py:100 → worker.py print_logs)."""
+    code = """
+import time
+import ray_tpu
+
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+def chatty():
+    print("hello-from-task-xyzzy")
+    return 1
+
+assert ray_tpu.get(chatty.remote(), timeout=120) == 1
+time.sleep(2.5)  # log monitor tick + pubsub delivery
+ray_tpu.shutdown()
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "hello-from-task-xyzzy" in out.stderr, out.stderr[-2000:]
