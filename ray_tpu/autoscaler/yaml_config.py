@@ -1,0 +1,130 @@
+"""Declarative cluster YAML + `up`/`down` (ref: autoscaler/ray-schema.json,
+`ray up`). Minimal schema:
+
+```yaml
+cluster_name: my-cluster
+provider:
+  type: local          # local | mock | gcp_tpu
+  # gcp_tpu extras: project, zone, accelerator_type (e.g. v5e-8), version
+max_workers: 8
+node_types:
+  cpu_worker:
+    resources: {CPU: 4}
+    min_workers: 1
+    max_workers: 4
+  tpu_worker:
+    resources: {CPU: 8, TPU: 4}
+    topology: v5e-8     # one provider node == one host of the slice gang
+    min_workers: 0
+    max_workers: 2
+```
+
+`up(path)` starts a head node (GCS + raylet), instantiates the provider, and
+runs a StandardAutoscaler reconcile thread honoring min/max workers;
+`down()` terminates provider nodes and the head.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (
+    LocalSubprocessProvider,
+    MockProvider,
+    NodeProvider,
+    NodeType,
+)
+
+
+def load_cluster_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict) or "node_types" not in cfg:
+        raise ValueError(f"{path}: expected a mapping with 'node_types'")
+    cfg.setdefault("provider", {"type": "local"})
+    cfg.setdefault("cluster_name", "ray-tpu-cluster")
+    return cfg
+
+
+def parse_node_types(cfg: dict) -> list[NodeType]:
+    out = []
+    for name, nt in cfg["node_types"].items():
+        out.append(NodeType(
+            name=name,
+            resources=dict(nt.get("resources", {"CPU": 1})),
+            min_workers=int(nt.get("min_workers", 0)),
+            max_workers=int(nt.get("max_workers",
+                                   cfg.get("max_workers", 10))),
+            labels=dict(nt.get("labels", {})),
+            topology=nt.get("topology"),
+        ))
+    return out
+
+
+def make_provider(cfg: dict, gcs_address) -> NodeProvider:
+    ptype = cfg["provider"].get("type", "local")
+    if ptype == "mock":
+        return MockProvider()
+    if ptype == "local":
+        return LocalSubprocessProvider(gcs_address)
+    if ptype == "gcp_tpu":
+        from ray_tpu.autoscaler.gcp_tpu import GcpTpuProvider
+
+        return GcpTpuProvider(cfg["provider"], gcs_address)
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+class ClusterUp:
+    """`ray up` equivalent: head + provider + autoscaler loop in-process."""
+
+    def __init__(self, config_path: str, *, update_interval_s: float = 2.0):
+        from ray_tpu.core.config import Config
+        from ray_tpu.core.node import Node
+
+        self.cfg = load_cluster_config(config_path)
+        self.head = Node(Config.from_env(), head=True,
+                         resources=dict(self.cfg.get(
+                             "head_resources", {"CPU": 2})))
+        self.head.start()
+        self.provider = make_provider(self.cfg, self.head.gcs_address)
+        self.autoscaler = StandardAutoscaler(
+            self.provider, parse_node_types(self.cfg),
+            gcs_address=self.head.gcs_address,
+        )
+        self._stop = threading.Event()
+        self._interval = update_interval_s
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        host, port = self.head.gcs_address
+        return f"{host}:{port}"
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.autoscaler.update()
+            except Exception:
+                pass
+            self._stop.wait(self._interval)
+
+    def down(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        term = getattr(self.provider, "terminate_all", None)
+        if term is not None:
+            term()
+        else:
+            for nid in self.provider.non_terminated_nodes():
+                self.provider.terminate_node(nid)
+        self.head.stop()
+
+
+def up(config_path: str) -> ClusterUp:
+    return ClusterUp(config_path)

@@ -98,6 +98,8 @@ class Raylet:
         self.gcs: rpc.Connection | None = None
         self.cluster_view: dict[bytes, dict] = {}
         self._pulls_inflight: dict[bytes, asyncio.Future] = {}
+        self._pull_bytes = 0          # admission accounting (bytes in flight)
+        self._pull_waiters: list = []  # FIFO of (size, future)
         self._peer_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._shutdown = False
         self._register_handlers()
@@ -945,9 +947,15 @@ class Raylet:
             except Exception:
                 pass
             return False
+        # Randomize holder order so a broadcast (N nodes pulling one hot
+        # object) spreads across replicas as copies appear, instead of
+        # serializing on the original holder (ref: push_manager.h dedup +
+        # pull location selection).
+        import random
+
+        locs = [l for l in locs if l["node_id"] != self.node_id]
+        random.shuffle(locs)
         for loc in locs:
-            if loc["node_id"] == self.node_id:
-                continue
             try:
                 peer = await self._peer(tuple(loc["address"]))
                 info = await peer.call("obj_info", {"object_id": obj.binary()},
@@ -955,26 +963,17 @@ class Raylet:
                 if info is None:
                     continue
                 size = info["size"]
-                chunk = self.config.object_transfer_chunk_size
                 if info["inline"]:
                     data = await peer.call("obj_read_chunk", {
                         "object_id": obj.binary(), "offset": 0, "length": size,
                     }, timeout=60.0)
                     self.store.put_inline(obj, data)
                 else:
-                    await self.store.create(obj, size)
-                    off = 0
-                    while off < size:
-                        n = min(chunk, size - off)
-                        data = await peer.call("obj_read_chunk", {
-                            "object_id": obj.binary(), "offset": off,
-                            "length": n,
-                        }, timeout=60.0)
-                        if data is None:
-                            raise rpc.RpcError("holder dropped object mid-pull")
-                        self.store.write_bytes(obj, off, data)
-                        off += n
-                    self.store.seal(obj)
+                    await self._pull_admission(size)
+                    try:
+                        await self._pull_chunks(obj, peer, size)
+                    finally:
+                        self._pull_release(size)
                 await self.gcs.call("obj_loc_add", {
                     "object_ids": [obj.binary()], "node_id": self.node_id,
                 })
@@ -982,7 +981,71 @@ class Raylet:
             except (rpc.RpcError, rpc.ConnectionLost, KeyError) as e:
                 logger.debug("pull from %s failed: %s", loc, e)
                 continue
+        # Every holder failed: abort any partially-created unsealed extent
+        # so the arena doesn't leak it (a later retry re-creates it).
+        e = self.store.entries.get(obj)
+        if e is not None and not e.sealed:
+            self.store.free(obj)
         return False
+
+    async def _pull_admission(self, size: int) -> None:
+        """FIFO admission control (ref: pull_manager.h:48): bound the bytes
+        of concurrently inbound pulls to a fraction of store capacity.
+        Strict arrival order — a large pull at the head admits as soon as
+        in-flight bytes drain, instead of being starved by a stream of
+        small pulls slipping past it."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pull_waiters.append((size, fut))
+        self._pump_pull_admission()
+        await fut
+
+    def _pump_pull_admission(self) -> None:
+        limit = max(int(self.store.capacity * 0.25),
+                    self.config.object_transfer_chunk_size)
+        while self._pull_waiters:
+            size, fut = self._pull_waiters[0]
+            if fut.done():
+                self._pull_waiters.pop(0)
+                continue
+            if self._pull_bytes > 0 and self._pull_bytes + size > limit:
+                break
+            self._pull_waiters.pop(0)
+            self._pull_bytes += size
+            fut.set_result(None)
+
+    def _pull_release(self, size: int) -> None:
+        self._pull_bytes -= size
+        self._pump_pull_admission()
+
+    async def _pull_chunks(self, obj: ObjectID, peer, size: int) -> None:
+        """Windowed parallel chunk fetch: overlap network round trips
+        (the r1 pull fetched 5 MiB chunks strictly serially)."""
+        chunk = self.config.object_transfer_chunk_size
+        await self.store.create(obj, size)
+        offsets = list(range(0, size, chunk))
+        sem = asyncio.Semaphore(4)
+
+        async def fetch(off: int):
+            async with sem:
+                n = min(chunk, size - off)
+                data = await peer.call("obj_read_chunk", {
+                    "object_id": obj.binary(), "offset": off, "length": n,
+                }, timeout=60.0)
+                if data is None:
+                    raise rpc.RpcError("holder dropped object mid-pull")
+                self.store.write_bytes(obj, off, data)
+
+        tasks = [asyncio.ensure_future(fetch(o)) for o in offsets]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            # Cancel + drain siblings: a straggler writing into the extent
+            # after we've moved on (or freed it) would corrupt a retry.
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        self.store.seal(obj)
 
     async def _h_node_info(self, conn, p):
         return {

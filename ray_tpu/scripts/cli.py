@@ -170,6 +170,43 @@ def cmd_timeline(args) -> None:
     print(f"wrote {len(events)} events to {args.output}")
 
 
+def cmd_debug(args) -> None:
+    from ray_tpu.utils import rpdb
+
+    _attach(args)
+    bps = rpdb.list_breakpoints()
+    if not bps:
+        print("no active breakpoints")
+        return
+    for i, bp in enumerate(bps):
+        print(f"[{i}] {bp['function']} {bp['file']}:{bp['line']} "
+              f"(pid {bp['pid']})")
+    idx = int(args.index if args.index is not None else input("attach to: "))
+    bp = bps[idx]
+    rpdb.attach(bp["host"], bp["port"])
+
+
+def cmd_up(args) -> None:
+    """Blocking by design: the head node + autoscaler live in THIS process
+    (Ctrl-C tears the cluster down). For a detached cluster use
+    `ray_tpu start --head` + workers, or run `up` under a supervisor."""
+    from ray_tpu.autoscaler.yaml_config import up
+
+    cluster = up(args.config)
+    print(json.dumps({"address": cluster.address,
+                      "cluster_name": cluster.cfg["cluster_name"]}),
+          flush=True)
+    import signal
+    import time as _t
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    while not stop:
+        _t.sleep(0.5)
+    cluster.down()
+
+
 def cmd_job(args) -> None:
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -223,6 +260,17 @@ def main(argv: list[str] | None = None) -> None:
     sp.add_argument("kind", choices=["nodes", "actors", "tasks", "jobs"])
     sp.add_argument("--address")
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("debug", help="list + attach to rpdb breakpoints")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--index", default=None)
+    sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser(
+        "up", help="start a cluster from a YAML config (blocking; "
+                   "Ctrl-C tears it down)")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_up)
 
     sp = sub.add_parser("memory", help="object store stats per node")
     sp.add_argument("--address")

@@ -1,0 +1,47 @@
+"""Chaos: random node kills under task load — the cluster heals and every
+task completes (ref: _private/test_utils.py:1245 NodeKillerActor +
+tests/test_chaos.py)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_tasks_survive_random_node_kills():
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    victims = [cluster.add_node(num_cpus=2) for _ in range(2)]
+    cluster.wait_for_nodes(3)
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(max_retries=5)
+        def work(i):
+            time.sleep(0.05)
+            return np.full(1 << 14, i % 200, np.uint8)
+
+        stop = threading.Event()
+
+        def killer():
+            # Kill a worker node mid-run, then add a replacement, then kill
+            # that one too — two waves of failure.
+            time.sleep(1.5)
+            cluster.remove_node(victims[0])
+            fresh = cluster.add_node(num_cpus=2)
+            time.sleep(2.0)
+            if not stop.is_set():
+                cluster.remove_node(victims[1])
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        refs = [work.remote(i) for i in range(120)]
+        out = ray_tpu.get(refs, timeout=300)
+        stop.set()
+        kt.join(timeout=30)
+        assert [int(a[0]) for a in out] == [i % 200 for i in range(120)]
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -217,3 +217,25 @@ def test_saturation_queues_instead_of_erroring(two_node_cluster):
     refs = [chunk.remote(i) for i in range(24)]
     out = ray_tpu.get(refs, timeout=120)
     assert [int(a[0]) for a in out] == [i % 120 for i in range(24)]
+
+
+def test_large_object_transfer_and_broadcast(two_node_cluster):
+    """64 MiB object pulled cross-node (windowed parallel chunks) and read
+    by tasks on both nodes (broadcast path, ref: object_manager push/pull)."""
+    import numpy as np
+
+    arr = np.random.default_rng(0).integers(0, 255, 64 << 20, np.uint8)
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote(resources={"special": 0.01})
+    def on_special(x):
+        return int(x[123]), int(x.sum() % 1000)
+
+    @ray_tpu.remote
+    def anywhere(x):
+        return int(x[123])
+
+    want = int(arr[123])
+    a, b = ray_tpu.get(
+        [on_special.remote(ref), anywhere.remote(ref)], timeout=180)
+    assert a[0] == want and b == want

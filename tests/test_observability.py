@@ -122,3 +122,40 @@ class TestTaskStateAggregation:
         summ = state.summarize_tasks()
         named = {t["name"]: t for t in summ["tasks"]}
         assert named.get("traced", {}).get("count", 0) >= 5
+
+
+class TestRemoteDebugger:
+    def test_set_trace_attach_continue(self, cluster):
+        """A task parks at rpdb.set_trace(); we list the breakpoint, attach
+        over TCP, and send `c` — the task resumes and completes
+        (ref: util/rpdb.py + `ray debug`)."""
+        import socket
+
+        from ray_tpu.utils import rpdb
+
+        @ray_tpu.remote(max_retries=0)
+        def buggy():
+            x = 41
+            rpdb.set_trace(timeout_s=60)
+            return x + 1
+
+        ref = buggy.remote()
+        deadline = time.monotonic() + 60
+        bps = []
+        while time.monotonic() < deadline and not bps:
+            bps = rpdb.list_breakpoints()
+            time.sleep(0.2)
+        assert bps, "breakpoint never registered"
+        bp = bps[0]
+        assert bp["function"] == "buggy"
+        sock = socket.create_connection((bp["host"], bp["port"]), timeout=30)
+        f = sock.makefile("rw", encoding="utf-8", newline="\n")
+        banner = f.readline()
+        assert "rpdb" in banner
+        # read until the pdb prompt, inspect a local, continue
+        sock.sendall(b"p x\n")
+        time.sleep(0.5)
+        sock.sendall(b"c\n")
+        sock.close()
+        assert ray_tpu.get(ref, timeout=60) == 42
+        assert rpdb.list_breakpoints() == []
