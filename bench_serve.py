@@ -1,0 +1,105 @@
+"""Serve benchmark: continuous-batched LLM serving — req/s + TTFT.
+
+BASELINE.json metric family 2 (Ray Serve req/s + p50 TTFT, OPT-1.3B-class
+text generation). Run:
+
+    python bench_serve.py [--model tiny|opt_1_3b] [--clients 16]
+        [--requests 64] [--json-out FILE]
+
+Drives the in-process LLMEngine directly (the Serve replica wraps exactly
+this engine; the router adds ~ms). On the real chip use --model opt_1_3b.
+Prints one JSON line:
+  {"metric": "serve_llm", "req_per_s": N, "ttft_p50_ms": N,
+   "ttft_p95_ms": N, "decode_tok_per_s": N, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--prompt-len", type=int, default=48)
+    ap.add_argument("--n-slots", type=int, default=8)
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args()
+
+    if args.model == "tiny":
+        # CI path: force the CPU backend before jax initializes.
+        from ray_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(1)
+
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg = gpt.GPTConfig.by_name(args.model)
+    engine = LLMEngine(cfg, n_slots=args.n_slots, max_len=1024)
+    engine.start()
+    rng = np.random.default_rng(0)
+
+    # Warm the prefill bucket + decode compile.
+    warm = engine.submit(
+        list(rng.integers(0, cfg.vocab_size, args.prompt_len)),
+        max_tokens=4)
+    warm.done.wait(600)
+
+    results = []
+    lock = threading.Lock()
+    todo = list(range(args.requests))
+
+    def client():
+        while True:
+            with lock:
+                if not todo:
+                    return
+                todo.pop()
+            ids = list(rng.integers(0, cfg.vocab_size, args.prompt_len))
+            req = engine.submit(ids, max_tokens=args.max_tokens)
+            req.done.wait(600)
+            if req.error:
+                continue
+            with lock:
+                results.append((req.first_token_at - req.submitted_at,
+                                req.finished_at - req.submitted_at,
+                                len(req.out_ids)))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client) for _ in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    engine.stop()
+
+    ttfts = sorted(r[0] for r in results)
+    toks = sum(r[2] for r in results)
+    row = {
+        "metric": "serve_llm",
+        "model": args.model,
+        "req_per_s": round(len(results) / wall, 2),
+        "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1000, 1),
+        "ttft_p95_ms": round(ttfts[int(len(ttfts) * 0.95)] * 1000, 1),
+        "decode_tok_per_s": round(toks / wall, 1),
+        "completed": len(results),
+        "clients": args.clients,
+        "wall_s": round(wall, 2),
+    }
+    print(json.dumps(row), flush=True)
+    if args.json_out:
+        json.dump(row, open(args.json_out, "w"))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,7 @@ runs a StandardAutoscaler reconcile thread honoring min/max workers;
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any
@@ -37,6 +38,8 @@ from ray_tpu.autoscaler.node_provider import (
     NodeProvider,
     NodeType,
 )
+
+logger = logging.getLogger(__name__)
 
 
 def load_cluster_config(path: str) -> dict:
@@ -87,15 +90,21 @@ class ClusterUp:
         from ray_tpu.core.node import Node
 
         self.cfg = load_cluster_config(config_path)
+        node_types = parse_node_types(self.cfg)  # validate before any spawn
         self.head = Node(Config.from_env(), head=True,
                          resources=dict(self.cfg.get(
                              "head_resources", {"CPU": 2})))
         self.head.start()
-        self.provider = make_provider(self.cfg, self.head.gcs_address)
-        self.autoscaler = StandardAutoscaler(
-            self.provider, parse_node_types(self.cfg),
-            gcs_address=self.head.gcs_address,
-        )
+        try:
+            self.provider = make_provider(self.cfg, self.head.gcs_address)
+            self.autoscaler = StandardAutoscaler(
+                self.provider, node_types,
+                gcs_address=self.head.gcs_address,
+            )
+        except BaseException:
+            # Don't leak a running head with no handle to stop it.
+            self.head.stop()
+            raise
         self._stop = threading.Event()
         self._interval = update_interval_s
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -111,7 +120,7 @@ class ClusterUp:
             try:
                 self.autoscaler.update()
             except Exception:
-                pass
+                logger.exception("autoscaler reconcile failed")
             self._stop.wait(self._interval)
 
     def down(self):

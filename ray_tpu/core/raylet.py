@@ -102,6 +102,7 @@ class Raylet:
         self._pull_waiters: list = []  # FIFO of (size, future)
         self._peer_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._shutdown = False
+        self._view_seen = 0            # last applied cluster-view version
         self._register_handlers()
 
     # ------------------------------------------------------------------ setup
@@ -152,6 +153,9 @@ class Raylet:
             await conn.call("register_node", self._register_payload())
             await conn.call("subscribe", {"channels": ["node"]})
             self.cluster_view = await conn.call("get_cluster_view", {})
+            # The restarted GCS's view-version counter restarted too; resync
+            # from zero or deltas would never ship again.
+            self._view_seen = 0
             logger.info("re-registered with restarted GCS")
 
         self.gcs = rpc.ReconnectingConnection(
@@ -227,8 +231,18 @@ class Raylet:
                 if resp.get("reregister"):
                     await self.gcs.call("register_node",
                                         self._register_payload())
-                # refresh cluster view opportunistically
-                self.cluster_view = await self.gcs.call("get_cluster_view", {})
+                # Versioned delta sync (ref: ray_syncer.h): pull only
+                # entries stamped since our last ack; an idle cluster
+                # exchanges nothing beyond the heartbeat itself.
+                vv = resp.get("view_version", -1)
+                if vv != self._view_seen:
+                    delta = await self.gcs.call(
+                        "get_view_delta", {"since": self._view_seen},
+                        timeout=10.0)
+                    for nid, nview in delta["nodes"].items():
+                        nview["address"] = tuple(nview["address"])
+                        self.cluster_view[nid] = nview
+                    self._view_seen = delta["version"]
             except (rpc.ConnectionLost, asyncio.TimeoutError):
                 if self._shutdown:
                     return
@@ -238,13 +252,14 @@ class Raylet:
                         *self.gcs_address, timeout=30.0,
                         notify_handler=self._gcs_notify,
                     )
-                    await self.gcs.call("register_node", {
-                        "node_id": self.node_id,
-                        "address": self.address,
-                        "resources": self.resources_total,
-                        "labels": self.labels,
-                    })
+                    await self.gcs.call("register_node",
+                                        self._register_payload())
                     await self.gcs.call("subscribe", {"channels": ["node"]})
+                    # Fresh GCS, fresh version counter: full resync or the
+                    # delta protocol would skip its low-stamped updates.
+                    self.cluster_view = await self.gcs.call(
+                        "get_cluster_view", {})
+                    self._view_seen = 0
                 except rpc.ConnectionLost:
                     pass
 

@@ -14,6 +14,14 @@ from ray_tpu.data.read_api import (
 )
 
 __all__ = [
-    "Dataset", "DatasetPipeline", "GroupedData", "from_arrow", "from_items", "from_numpy",
-    "from_pandas", "range", "read_csv", "read_json", "read_parquet",
+    "Dataset", "DatasetPipeline", "Datasource", "GroupedData", "ReadTask",
+    "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
+    "read_csv", "read_datasource", "read_json", "read_parquet",
+    "write_datasource",
 ]
+from ray_tpu.data.datasource import (  # noqa: E402,F401
+    Datasource,
+    ReadTask,
+    read_datasource,
+    write_datasource,
+)

@@ -1,0 +1,208 @@
+"""Functional Llama-family decoder, TPU-first.
+
+Second model family beside GPT (`models/gpt.py`) — the architectural trio
+that distinguishes it: RMSNorm (no bias/mean), SwiGLU MLP, and grouped-query
+attention (n_kv_heads < n_heads). Same design rules as gpt.py: one
+PARAM_SPECS-style table drives init/sharding/checkpointing, per-layer
+weights stack on a leading `layers` axis and scan, bf16 activations / fp32
+params, rotary over the full head dim.
+
+Sharding: heads/mlp over `tp`, embed over `fsdp`, kv heads replicate across
+tp when n_kv_heads < tp would not divide (GQA kv heads use the `kv_heads`
+logical axis so small-kv models keep correctness over big tp meshes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8              # GQA: kv heads < query heads
+    d_ff: int = 11008                # SwiGLU hidden
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: str = "xla"           # "xla" | "flash" | "ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        kw.setdefault("remat", True)
+        return cls(d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+                   d_ff=11008, **kw)
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        kw.setdefault("vocab_size", 128256)
+        kw.setdefault("rope_theta", 500000.0)
+        kw.setdefault("remat", True)
+        return cls(d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+                   d_ff=14336, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq", 128)
+        kw.setdefault("d_model", 64)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 8)
+        kw.setdefault("n_kv_heads", 4)
+        kw.setdefault("d_ff", 128)
+        return cls(**kw)
+
+
+def param_specs(cfg: LlamaConfig) -> dict[str, dict[str, Any]]:
+    D, H, KV, K, F, L, V = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                            cfg.head_dim, cfg.d_ff, cfg.n_layers,
+                            cfg.vocab_size)
+    norm = lambda *s: {"init": "normal", "scale": 0.02, "shape": s}
+    resid = lambda *s: {"init": "normal",
+                        "scale": 0.02 / math.sqrt(2 * L), "shape": s}
+    ones = lambda *s: {"init": "ones", "shape": s}
+    return {
+        "tok_emb": {**norm(V, D), "axes": ("vocab", "embed")},
+        "norm_f": {**ones(D), "axes": ("embed",)},
+        "lm_head": {**norm(D, V), "axes": ("embed", "vocab")},
+        "attn_norm": {**ones(L, D), "axes": ("layers", "embed")},
+        "wq": {**norm(L, D, H, K), "axes": ("layers", "embed", "heads", "kv")},
+        "wk": {**norm(L, D, KV, K),
+               "axes": ("layers", "embed", "kv_heads", "kv")},
+        "wv": {**norm(L, D, KV, K),
+               "axes": ("layers", "embed", "kv_heads", "kv")},
+        "wo": {**resid(L, H, K, D), "axes": ("layers", "heads", "kv", "embed")},
+        "mlp_norm": {**ones(L, D), "axes": ("layers", "embed")},
+        "w_gate": {**norm(L, D, F), "axes": ("layers", "embed", "mlp")},
+        "w_up": {**norm(L, D, F), "axes": ("layers", "embed", "mlp")},
+        "w_down": {**resid(L, F, D), "axes": ("layers", "mlp", "embed")},
+    }
+
+
+def logical_axes(cfg: LlamaConfig) -> dict[str, tuple]:
+    return {k: v["axes"] for k, v in param_specs(cfg).items()}
+
+
+def init_params(cfg: LlamaConfig, rng: jax.Array) -> dict[str, jax.Array]:
+    specs = param_specs(cfg)
+    keys = jax.random.split(rng, len(specs))
+    out = {}
+    for key, (name, s) in zip(keys, sorted(specs.items())):
+        if s["init"] == "normal":
+            out[name] = jax.random.normal(
+                key, s["shape"], cfg.param_dtype) * s["scale"]
+        else:
+            out[name] = jnp.ones(s["shape"], cfg.param_dtype)
+    return out
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (y * scale).astype(x.dtype)
+
+
+def _rotary(x: jax.Array, theta: float, offset: int = 0) -> jax.Array:
+    """Full-head-dim rotary over x[..., S, H, K]."""
+    S, K = x.shape[-3], x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, K, 2) / K))
+    pos = jnp.arange(offset, offset + S)[:, None] * inv_freq[None, :]
+    sin = jnp.sin(pos)[:, None, :].astype(x.dtype)
+    cos = jnp.cos(pos)[:, None, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+
+def _gqa_attention(q, k, v, cfg: LlamaConfig, *, causal_offset: int = 0,
+                   mesh=None):
+    """q [B,S,H,K]; k,v [B,T,KV,K] with KV | H. Repeats kv groups to the
+    query-head count, then dispatches to the configured attention impl —
+    the repeat is a broadcast XLA folds into the einsum (no materialized
+    copy on TPU)."""
+    groups = cfg.n_heads // cfg.n_kv_heads
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    if cfg.attn_impl == "flash" and causal_offset == 0:
+        from ray_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "ring" and causal_offset == 0:
+        from ray_tpu.parallel.ring import ring_attention_sharded
+
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        return ring_attention_sharded(q, k, v, mesh, causal=True, impl=impl)
+    S, T = q.shape[-3], k.shape[-3]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bshk,bthk->bhst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    qpos = jnp.arange(S)[:, None] + causal_offset
+    mask = qpos >= jnp.arange(T)[None, :]
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthk->bshk", probs, v)
+
+
+def _block(x, layer, cfg: LlamaConfig, mesh=None):
+    h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dgk->bsgk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dgk->bsgk", h, layer["wv"].astype(cfg.dtype))
+    q = _rotary(q, cfg.rope_theta)
+    k = _rotary(k, cfg.rope_theta)
+    attn = _gqa_attention(q, k, v, cfg, mesh=mesh)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(cfg.dtype))
+    h = _rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(cfg.dtype))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+    down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                      layer["w_down"].astype(cfg.dtype))
+    return x + down
+
+
+_BLOCK_KEYS = ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+               "w_gate", "w_up", "w_down")
+
+
+def forward(params, tokens, cfg: LlamaConfig, mesh=None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, V] fp32."""
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+
+    def body(x, layer):
+        fn = (jax.checkpoint(lambda a, l: _block(a, l, cfg, mesh))
+              if cfg.remat else (lambda a, l: _block(a, l, cfg, mesh)))
+        return fn(x, layer), None
+
+    x, _ = jax.lax.scan(body, x, stacked)
+    x = _rms_norm(x, params["norm_f"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(params, tokens, targets, cfg: LlamaConfig, mesh=None) -> jax.Array:
+    logits = forward(params, tokens, cfg, mesh)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    return sum(math.prod(s["shape"]) for s in param_specs(cfg).values())

@@ -46,6 +46,7 @@ DEFAULT_LOGICAL_RULES: tuple[tuple[str, Any], ...] = (
     ("mlp", "tp"),               # megatron: shard mlp hidden over tp
     ("heads", "tp"),             # megatron: shard attention heads over tp
     ("kv", None),
+    ("kv_heads", None),          # GQA kv heads (too few to shard over tp)
     ("vocab", "tp"),
     ("layers", None),            # stacked-layer leading axis (scanned)
     ("expert", "ep"),            # MoE experts sharded over ep

@@ -97,32 +97,31 @@ def build_training(
     optimizer: optax.GradientTransformation,
     rng: jax.Array,
     rules=DEFAULT_LOGICAL_RULES,
+    model=None,
 ):
-    """End-to-end: GPT params + opt state sharded on `mesh`, jitted step.
+    """End-to-end: model params + opt state sharded on `mesh`, jitted step.
 
+    `model` is a module exposing logical_axes/init_params/loss_fn (defaults
+    to models.gpt; models.llama works identically — the PARAM_SPECS table
+    convention makes trainers model-agnostic).
     Returns (params, opt_state, step_fn) where
-    step_fn(params, opt_state, tokens, targets) -> (params, opt_state, loss).
+    step_fn(params, opt_state, (tokens, targets)) -> (params, opt_state, loss).
     """
-    from ray_tpu.models import gpt
+    if model is None:
+        from ray_tpu.models import gpt as model
 
-    logical = gpt.logical_axes(cfg)
+    logical = model.logical_axes(cfg)
     params, p_shard = sharded_init(
-        partial(gpt.init_params, cfg), logical, mesh, rng, rules
+        partial(model.init_params, cfg), logical, mesh, rng, rules
     )
     o_shard = opt_state_shardings(optimizer, params, p_shard)
     opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
-    loss = partial_loss(cfg, mesh)
-    step_fn = make_train_step(loss, optimizer, mesh, p_shard, o_shard)
-    return params, opt_state, step_fn
-
-
-def partial_loss(cfg, mesh=None):
-    from ray_tpu.models import gpt
 
     def loss(params, tokens, targets):
-        return gpt.loss_fn(params, tokens, targets, cfg, mesh)
+        return model.loss_fn(params, tokens, targets, cfg, mesh)
 
-    return loss
+    step_fn = make_train_step(loss, optimizer, mesh, p_shard, o_shard)
+    return params, opt_state, step_fn
 
 
 def build_pipeline_training(
