@@ -338,6 +338,33 @@ class Dataset:
         if carry is not None and not drop_last:
             yield B.to_batch(carry, batch_format)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           device: str = "cpu") -> Iterator:
+        """Torch-tensor batches (ref: dataset.py:2833 to_torch /
+        iter_torch_batches) — CPU-torch interop for preprocessing or
+        torch-based models riding this data plane."""
+        import torch
+
+        def to_tensor(name, v):
+            arr = np.asarray(v)
+            if arr.dtype == object:
+                raise TypeError(
+                    f"column {name!r} has non-numeric rows (dtype=object); "
+                    "torch tensors need numeric columns — map/encode it "
+                    "first")
+            # Copy: arrow-backed numpy views are read-only, and wrapping
+            # them zero-copy yields tensors whose in-place ops are UB.
+            return torch.as_tensor(np.ascontiguousarray(arr), device=device)
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if isinstance(batch, dict):
+                yield {k: to_tensor(k, v) for k, v in batch.items()}
+            else:
+                yield to_tensor("<array>", batch)
+
     def iter_tpu_batches(
         self,
         *,

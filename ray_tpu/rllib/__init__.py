@@ -1,12 +1,13 @@
 """RLlib-equivalent: scalable reinforcement learning on the TPU runtime.
 
 Parity: `/root/reference/rllib/` — Algorithm/AlgorithmConfig driver,
-WorkerSet of rollout actors, policy abstraction, replay buffers, PPO + DQN.
+WorkerSet of rollout actors, policy abstraction, replay buffers, PPO/A2C/DQN.
 Compute is functional JAX (jitted sampling + donated SGD steps); rollouts
 are numpy vector envs on host actors.
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import (
     CartPole,
@@ -22,7 +23,8 @@ from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+    "A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig",
+    "DQN", "DQNConfig",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
     "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",
     "Pendulum", "make_env", "register_env",

@@ -1,0 +1,89 @@
+"""A2C: synchronous advantage actor-critic.
+
+Parity: `/root/reference/rllib/algorithms/a2c/` — the on-policy gradient
+without PPO's ratio clipping: one fused update per collected batch using
+GAE advantages, a value-function MSE term and an entropy bonus. Shares the
+rollout/GAE/policy machinery with PPO; the whole update is a single jitted
+dispatch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.sample_batch import SampleBatch  # noqa: F401
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.lambda_ = 1.0           # classic A2C: plain returns
+        self.grad_clip = 0.5
+
+
+class A2C(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> A2CConfig:
+        return A2CConfig()
+
+    def setup(self) -> None:
+        cfg: A2CConfig = self.config
+        self.policy = self.workers.local.policy
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr),
+        )
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+
+    def _loss(self, params, batch):
+        cfg: A2CConfig = self.config
+        pol = self.policy
+        logp = pol._logp(params, batch[sb.OBS], batch[sb.ACTIONS])
+        pg_loss = -jnp.mean(logp * batch[sb.ADVANTAGES])
+        vf = pol.value(params, batch[sb.OBS])
+        vf_loss = jnp.mean((vf - batch[sb.VALUE_TARGETS]) ** 2)
+        entropy = jnp.mean(pol._entropy(params, batch[sb.OBS]))
+        loss = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                - cfg.entropy_coeff * entropy)
+        return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                      "entropy": entropy}
+
+    def _update_impl(self, params, opt_state, batch):
+        (loss, info), grads = jax.value_and_grad(
+            self._loss, has_aux=True)(params, batch)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, info
+
+    def training_step(self) -> dict:
+        cfg: A2CConfig = self.config
+        train_batch = sb.collect_on_policy_batch(
+            self.workers, gamma=cfg.gamma, lam=cfg.lambda_)
+        self._timesteps_total += train_batch.count
+        dev = {k: jnp.asarray(v) for k, v in train_batch.items()}
+        self.policy.params, self.opt_state, loss, info = self._update(
+            self.policy.params, self.opt_state, dev)
+        return {
+            "total_loss": float(loss),
+            "policy_loss": float(info["policy_loss"]),
+            "vf_loss": float(info["vf_loss"]),
+            "entropy": float(info["entropy"]),
+        }
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+
+A2CConfig.algo_class = A2C

@@ -94,20 +94,9 @@ class PPO(Algorithm):
 
     def training_step(self) -> dict:
         cfg: PPOConfig = self.config
-        self.workers.sync_weights(self.policy.get_weights())
-        batches = self.workers.sample()
-        # GAE per worker fragment (time-major), then flatten + concat.
-        flat = []
-        for b in batches:
-            last_values = b.pop("last_values")
-            flat.append(flatten_time_major(
-                compute_gae(b, last_values, gamma=cfg.gamma, lam=cfg.lambda_)))
-        train_batch = SampleBatch.concat(flat)
+        train_batch = sb.collect_on_policy_batch(
+            self.workers, gamma=cfg.gamma, lam=cfg.lambda_)
         self._timesteps_total += train_batch.count
-
-        adv = train_batch[sb.ADVANTAGES]
-        train_batch[sb.ADVANTAGES] = (
-            (adv - adv.mean()) / max(1e-8, adv.std())).astype(np.float32)
 
         mb = cfg.sgd_minibatch_size
         n_mb = max(1, train_batch.count // mb)

@@ -100,3 +100,24 @@ def flatten_time_major(batch: SampleBatch) -> SampleBatch:
     return SampleBatch(
         {k: v.reshape((-1,) + v.shape[2:]) for k, v in batch.items()}
     )
+
+
+def collect_on_policy_batch(workers, *, gamma: float, lam: float,
+                            normalize_advantages: bool = True) -> SampleBatch:
+    """Shared on-policy batch prep (PPO/A2C): sync weights, sample all
+    workers, GAE per time-major fragment, flatten + concat, and normalize
+    advantages. One definition so the GAE/normalization details can't
+    silently diverge between algorithms."""
+    workers.sync_weights(workers.local.policy.get_weights())
+    batches = workers.sample()
+    flat = []
+    for b in batches:
+        last_values = b.pop("last_values")
+        flat.append(flatten_time_major(
+            compute_gae(b, last_values, gamma=gamma, lam=lam)))
+    train_batch = SampleBatch.concat(flat)
+    if normalize_advantages:
+        adv = train_batch[ADVANTAGES]
+        train_batch[ADVANTAGES] = (
+            (adv - adv.mean()) / max(1e-8, adv.std())).astype(np.float32)
+    return train_batch

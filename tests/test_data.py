@@ -236,3 +236,14 @@ def test_datasource_plugin_roundtrip(cluster, tmp_path):
     for p in glob.glob(str(tmp_path / "sink" / "*.json")):
         rows += [_json.loads(l) for l in open(p)]
     assert sorted(r["x"] for r in rows) == list(range(40))
+
+
+def test_iter_torch_batches(cluster):
+    import torch
+
+    ds = rd.range(40)
+    seen = 0
+    for b in ds.iter_torch_batches(batch_size=16):
+        assert isinstance(b["id"], torch.Tensor)
+        seen += b["id"].shape[0]
+    assert seen == 40
