@@ -68,6 +68,17 @@ class ObjectRef:
         if c is not None:
             c.incref(object_id.binary())
 
+    @classmethod
+    def _uncounted(cls, object_id: ObjectID) -> "ObjectRef":
+        """A ref that holds NO local count (internal): used where another
+        mechanism (e.g. refs-in-refs containment escrow) owns the lifetime
+        and the instance may sit in asyncio frame cycles whose __del__ only
+        runs at an unpredictable gc.collect()."""
+        r = object.__new__(cls)
+        r.id = object_id
+        r._counter = None
+        return r
+
     def hex(self) -> str:
         return self.id.hex()
 
@@ -284,17 +295,20 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         client = _ensure_client()
         o = self._options
+        nr = o.get("num_returns", 1)
+        dynamic = nr == "dynamic"
         refs = client.submit_task(
             self._blob(),
             getattr(self._fn, "__name__", "task"),
             args, kwargs,
-            num_returns=o.get("num_returns", 1),
+            num_returns=1 if dynamic else nr,
+            dynamic_returns=dynamic,
             resources=_build_resources(o),
             max_retries=o.get("max_retries"),
             scheduling_strategy=_strategy_payload(o),
             runtime_env=o.get("runtime_env"),
         )
-        return refs[0] if o.get("num_returns", 1) == 1 else refs
+        return refs[0] if dynamic or nr == 1 else refs
 
     def bind(self, *args, **kwargs):
         """Build a lazy DAG node instead of submitting (ref: dag/dag_node.py);
@@ -347,6 +361,7 @@ class ActorMethod:
             self._name, args, kwargs,
             num_returns=self._num_returns,
             concurrency_group=self._concurrency_group,
+            max_task_retries=self._handle._max_task_retries,
         )
         return refs[0] if self._num_returns == 1 else refs
 
@@ -354,8 +369,12 @@ class ActorMethod:
 class ActorHandle:
     """Callable handle to a live actor (ref: actor.py:1020)."""
 
-    def __init__(self, actor_id: ActorID):
+    def __init__(self, actor_id: ActorID, max_task_retries: int = 0):
         self._actor_id = actor_id
+        # Retries for this actor's METHOD calls after an actor crash +
+        # restart (distinct from task max_retries; ref:
+        # ray_option_utils.py:158-159 max_task_retries).
+        self._max_task_retries = max_task_retries
 
     def __getattr__(self, item: str) -> ActorMethod:
         if item.startswith("_"):
@@ -363,7 +382,7 @@ class ActorHandle:
         return ActorMethod(self, item)
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id,))
+        return (ActorHandle, (self._actor_id, self._max_task_retries))
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()})"
@@ -411,8 +430,10 @@ class ActorClass:
             get_if_exists=o.get("get_if_exists", False),
             runtime_env=o.get("runtime_env"),
             concurrency_groups=o.get("concurrency_groups"),
+            max_task_retries=o.get("max_task_retries", 0),
         )
-        return ActorHandle(ActorID(actor_id))
+        return ActorHandle(ActorID(actor_id),
+                           max_task_retries=o.get("max_task_retries", 0))
 
     def __call__(self, *args, **kwargs):
         raise TypeError("Actor class cannot be instantiated directly; "
@@ -485,10 +506,13 @@ def cancel(ref: ObjectRef, *, force: bool = False,
 
 
 def get_actor(name: str) -> ActorHandle:
-    actor_id = _ensure_client().get_named_actor(name)
-    if actor_id is None:
+    found = _ensure_client().get_named_actor(name)
+    if found is None:
         raise ValueError(f"no alive actor named {name!r}")
-    return ActorHandle(ActorID(actor_id))
+    actor_id, max_task_retries = found
+    # Retry semantics ride the GCS actor record, so a handle fetched by
+    # name behaves like the creator's handle.
+    return ActorHandle(ActorID(actor_id), max_task_retries=max_task_retries)
 
 
 # --------------------------------------------------------------- cluster info

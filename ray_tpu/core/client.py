@@ -678,6 +678,7 @@ class CoreClient:
         kwargs: dict,
         *,
         num_returns: int = 1,
+        dynamic_returns: bool = False,
         resources: dict[str, float] | None = None,
         max_retries: int | None = None,
         scheduling_strategy: Any = None,
@@ -710,6 +711,7 @@ class CoreClient:
             args=arg_specs,
             kwargs_keys=kw_keys,
             num_returns=n,
+            dynamic_returns=dynamic_returns,
             return_ids=return_ids,
             resources=resources or {"CPU": 1},
             max_retries=(
@@ -1105,6 +1107,7 @@ class CoreClient:
         get_if_exists: bool = False,
         runtime_env: dict | None = None,
         concurrency_groups: dict[str, int] | None = None,
+        max_task_retries: int = 0,
     ) -> bytes:
         from ray_tpu.core.runtime_env import resolve_runtime_env
 
@@ -1117,7 +1120,7 @@ class CoreClient:
         result = self._run(self._create_actor_async(
             st, cls_blob, name, args, kwargs, resources, hold_resources,
             max_restarts, max_concurrency, actor_name, get_if_exists,
-            runtime_env, concurrency_groups,
+            runtime_env, concurrency_groups, max_task_retries,
         ))
         if isinstance(result, bytes):       # got existing named actor
             return result
@@ -1126,7 +1129,7 @@ class CoreClient:
     async def _create_actor_async(
         self, st, cls_blob, name, args, kwargs, resources, hold_resources,
         max_restarts, max_concurrency, actor_name, get_if_exists,
-        runtime_env=None, concurrency_groups=None,
+        runtime_env=None, concurrency_groups=None, max_task_retries=0,
     ):
         task_id = TaskID.for_actor_task(ActorID(st.actor_id))
         arg_specs, kw_keys, escrow = self._build_args(args, kwargs)
@@ -1154,6 +1157,7 @@ class CoreClient:
             "actor_id": st.actor_id,
             "name": actor_name,
             "max_restarts": max_restarts,
+            "max_task_retries": max_task_retries,
             "resources": resources,
             "create_spec": serialization.dumps_call(spec),
         })
@@ -1260,6 +1264,7 @@ class CoreClient:
         *,
         num_returns: int = 1,
         concurrency_group: str | None = None,
+        max_task_retries: int = 0,
     ) -> list:
         from ray_tpu.api import ObjectRef
 
@@ -1287,6 +1292,7 @@ class CoreClient:
             actor_id=actor_id,
             method_name=method_name,
             concurrency_group=concurrency_group,
+            max_retries=max_task_retries,
         )
         for rid in return_ids:
             self._result_events[rid] = threading.Event()
@@ -1525,14 +1531,15 @@ class CoreClient:
     def list_placement_groups(self) -> list:
         return self._run(self.gcs.call("pg_list", {}), timeout=30)
 
-    def get_named_actor(self, name: str) -> bytes | None:
+    def get_named_actor(self, name: str):
+        """→ (actor_id, max_task_retries) or None."""
         info = self._run(self.gcs.call("get_actor", {"name": name}))
         if info is None or info["state"] == "DEAD":
             return None
         st = self.actor_state(info["actor_id"])
         if info["address"]:
             st.address = tuple(info["address"])
-        return info["actor_id"]
+        return info["actor_id"], info.get("max_task_retries", 0)
 
     # ------------------------------------------------------------ cluster info
 

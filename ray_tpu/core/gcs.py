@@ -55,6 +55,7 @@ class ActorInfo:
     address: tuple[str, int] | None = None   # owning worker RPC endpoint
     num_restarts: int = 0
     max_restarts: int = 0
+    max_task_retries: int = 0
     create_spec: bytes | None = None          # serialized creation task
     owner_address: tuple[str, int] | None = None
     death_cause: str | None = None
@@ -144,6 +145,7 @@ class GcsServer:
         s.register("ref_update", self._ref_update)
         s.register("ref_revive", self._ref_revive)
         s.register("obj_request_recovery", self._obj_request_recovery)
+        s.register("ref_debug", self._ref_debug)
         s.register("pg_create", self._pg_create)
         s.register("pg_remove", self._pg_remove)
         s.register("pg_get", self._pg_get)
@@ -458,6 +460,7 @@ class GcsServer:
             name=name,
             state=PENDING,
             max_restarts=p.get("max_restarts", 0),
+            max_task_retries=p.get("max_task_retries", 0),
             create_spec=p.get("create_spec"),
             owner_address=tuple(p["owner_address"]) if p.get("owner_address") else None,
             resources=dict(p.get("resources", {})),
@@ -597,6 +600,7 @@ class GcsServer:
             "actor_id": info.actor_id, "state": info.state,
             "address": info.address, "node_id": info.node_id,
             "name": info.name, "num_restarts": info.num_restarts,
+            "max_task_retries": info.max_task_retries,
             "death_cause": info.death_cause,
         }
 
@@ -715,6 +719,18 @@ class GcsServer:
                 c.notify("recover_objects", {"object_ids": [obj]})
                 notified.append(obj)
         return {"notified": notified}
+
+    async def _ref_debug(self, conn, p):
+        """Introspection for `ray_tpu memory`/debugging: who holds what."""
+        out = {}
+        for obj in p.get("object_ids", ()):
+            out[obj] = {
+                "holders": sorted(self.ref_holders.get(obj, set())),
+                "owner": self.obj_owner.get(obj),
+                "contained_by": [o for o, inners in self.contained.items()
+                                 if obj in inners],
+            }
+        return out
 
     def _ref_release(self, holder: bytes, obj: bytes,
                      free_unknown: bool = False) -> None:

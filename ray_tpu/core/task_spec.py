@@ -35,6 +35,10 @@ class TaskSpec:
     args: list[ArgSpec] = field(default_factory=list)
     kwargs_keys: list[str] = field(default_factory=list)  # trailing args are kwargs
     num_returns: int = 1
+    # num_returns="dynamic": the task body is a generator; each yielded item
+    # is stored as its own object and the single return resolves to the list
+    # of their refs (ref: _raylet.pyx:602 dynamic generator returns).
+    dynamic_returns: bool = False
     return_ids: list[bytes] = field(default_factory=list)
     resources: dict[str, float] = field(default_factory=dict)
     hold_resources: dict[str, float] | None = None  # actor lifetime holdings

@@ -357,6 +357,8 @@ class Worker:
             fn = serialization.unpack(spec.fn_blob)
             args, kwargs = self._resolve_args(spec)
             out = fn(*args, **kwargs)
+            if spec.dynamic_returns:
+                return [self._expand_dynamic(spec, out)], None
             return self._split_returns(spec, out), None
         except _Cancelled as e:
             err = TaskError("TaskCancelledError", str(e) or "cancelled", "")
@@ -447,6 +449,50 @@ class Worker:
         except Exception as e:
             err = TaskError(type(e).__name__, str(e), traceback.format_exc())
             return [err] * max(1, spec.num_returns), err
+
+    def _expand_dynamic(self, spec: TaskSpec, gen) -> list:
+        """num_returns="dynamic" (ref: _raylet.pyx:602): stream the task's
+        generator into per-item objects; the task's single return is the
+        list of their refs. The returned list's serialization registers
+        refs-in-refs containment, so the items are GC'd exactly when the
+        list object is — no special casing in the ref counter."""
+        from ray_tpu import api
+        from ray_tpu.api import ObjectRef
+        from ray_tpu.core import serialization as ser
+        from ray_tpu.core.ids import TaskID
+
+        client = api._ensure_client()
+        refs = []
+        task_id = TaskID(spec.task_id)
+        try:
+            for i, item in enumerate(gen):
+                oid = ObjectID.for_return(task_id, i + 1)
+                head, views = ser.serialize(item)
+                # This worker creates (owns) the item objects.
+                client.refcounter.mark_owned(oid.binary())
+                client._run(client._store_serialized(
+                    oid.binary(), head, views))
+                # Uncounted: the containment escrow from serializing this
+                # list (store_returns → add_contains) holds the items until
+                # the GCS registers the outer object's pseudo-holds; a
+                # counted ref here would pin them until an unpredictable
+                # worker gc.collect().
+                refs.append(ObjectRef._uncounted(oid))
+        except BaseException:
+            # Generator raised/cancelled mid-stream: already-stored items
+            # have no holders or containment yet — free them now or they
+            # leak in the node store for the worker pool's lifetime.
+            stored = [r.id.binary() for r in refs]
+            if stored:
+                try:
+                    client._run(client.raylet.call(
+                        "store_free", {"object_ids": stored}, timeout=30))
+                    client._run(client.gcs.call(
+                        "obj_free", {"object_ids": stored}, timeout=30))
+                except Exception:
+                    pass
+            raise
+        return refs
 
     @staticmethod
     def _split_returns(spec: TaskSpec, out: Any) -> list:

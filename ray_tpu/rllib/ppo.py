@@ -17,7 +17,6 @@ import optax
 
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae, flatten_time_major
 
 
 class PPOConfig(AlgorithmConfig):

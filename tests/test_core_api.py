@@ -223,3 +223,100 @@ def test_free(cluster):
     ref = ray_tpu.put(np.ones(200_000))
     assert ray_tpu.get(ref, timeout=10) is not None
     ray_tpu.free([ref])
+
+
+class TestDynamicReturns:
+    def test_generator_returns_list_of_refs(self, cluster):
+        """num_returns="dynamic" (ref: dynamic generator returns,
+        _raylet.pyx:602): a generator task yields N objects; the single
+        return resolves to their refs."""
+        import numpy as np
+
+        @ray_tpu.remote(num_returns="dynamic")
+        def gen(n):
+            for i in range(n):
+                yield np.full(8, i, np.int64)
+
+        ref = gen.remote(5)
+        item_refs = ray_tpu.get(ref, timeout=60)
+        assert len(item_refs) == 5
+        vals = ray_tpu.get(item_refs, timeout=60)
+        assert [int(v[0]) for v in vals] == [0, 1, 2, 3, 4]
+
+    def test_dynamic_items_gcd_with_outer(self, cluster):
+        """Dropping the outer ref (and item refs) reclaims the items via
+        refs-in-refs containment."""
+        import gc
+        import time
+
+        import numpy as np
+        from ray_tpu import api
+
+        @ray_tpu.remote(num_returns="dynamic")
+        def gen():
+            for i in range(3):
+                yield np.zeros(1 << 17, np.uint8)  # 128 KiB each, in shm
+
+        client = api._client
+
+        def shm():
+            return client._run(client.raylet.call("store_stats", {}))["shm_bytes"]
+
+        base = shm()
+        ref = gen.remote()
+        items = ray_tpu.get(ref, timeout=60)
+        assert shm() >= base + 3 * (1 << 17)
+        oids = [r.id.binary() for r in items]
+        del ref, items
+        gc.collect()
+        client.refcounter.flush_now()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and shm() > base + 4096:
+            client.refcounter.flush_now()
+            time.sleep(0.3)
+        assert shm() <= base + 4096, shm()
+        # GCS-side introspection agrees: no holders remain on any item.
+        dbg = client._run(client.gcs.call(
+            "ref_debug", {"object_ids": oids}))
+        for oid, info in dbg.items():
+            assert not info["holders"], (oid.hex()[:12], info)
+
+
+def test_max_task_retries_resubmits_after_actor_restart(cluster, tmp_path):
+    """max_task_retries (distinct from task max_retries, ref:
+    ray_option_utils.py:158-159): a method call in flight when the actor
+    dies is resubmitted to the restarted instance."""
+    import os
+
+    marker = str(tmp_path / "died-once")
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+    class Fragile:
+        def risky(self, marker):
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)  # die mid-call, first time only
+            return "recovered"
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.risky.remote(marker), timeout=120) == "recovered"
+
+
+def test_actor_task_default_no_retry(cluster, tmp_path):
+    """Without max_task_retries, a call in flight when the actor dies fails
+    (it may have partially executed)."""
+    import os
+
+    marker = str(tmp_path / "died-once-2")
+
+    @ray_tpu.remote(max_restarts=2)
+    class Fragile:
+        def risky(self, marker):
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)
+            return "recovered"
+
+    f = Fragile.remote()
+    with pytest.raises(ray_tpu.api.RayTaskError):
+        ray_tpu.get(f.risky.remote(marker), timeout=120)
