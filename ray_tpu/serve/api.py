@@ -223,14 +223,54 @@ class DeploymentHandle:
         replica = self._pick_replica()
         return replica.handle_request.remote(method_name, args, kwargs)
 
+    def __reduce__(self):
+        # Handles travel into replica constructors (deployment graphs);
+        # routing state (locks, caches) rebuilds in the destination process.
+        return (DeploymentHandle, (self.deployment_name,))
+
+
+def _resolve_graph(args, kwargs, *, blocking: bool, deadline: float):
+    """Deployment-graph composition (ref: serve DAG API, serve/dag.py):
+    Deployment instances bound as init args deploy first (depth-first) and
+    are replaced by handles, so a deployment's constructor receives live
+    DeploymentHandles to its dependencies. Children deploy WITHOUT an HTTP
+    route (only the ingress is routable) and share the caller's deadline."""
+
+    def sub(v):
+        if isinstance(v, Deployment):
+            child = v.options(route_prefix=None)  # internal: not routable
+            return run(child, _blocking_until_ready=blocking,
+                       _deadline=deadline)
+        if isinstance(v, (list, tuple)):
+            return type(v)(sub(x) for x in v)
+        if isinstance(v, dict):
+            return {k: sub(x) for k, x in v.items()}
+        return v
+
+    return tuple(sub(a) for a in args), {k: sub(v)
+                                         for k, v in (kwargs or {}).items()}
+
 
 def run(target: Deployment, *, name: str | None = None,
         route_prefix: str | None = None, _blocking_until_ready: bool = True,
-        timeout: float = 120.0) -> DeploymentHandle:
+        timeout: float = 120.0,
+        _deadline: float | None = None) -> DeploymentHandle:
     ctrl = _get_controller(create=True)
+    deadline = _deadline if _deadline is not None else (
+        time.monotonic() + timeout)
+
+    def remaining(cap: float = 120.0) -> float:
+        return max(0.5, min(cap, deadline - time.monotonic()))
+
     dep = target
+    if name is not None:
+        dep = dep.options(name=name)
     if route_prefix is not None:
         dep = dep.options(route_prefix=route_prefix)
+    init_args, init_kwargs = _resolve_graph(
+        dep.init_args, dep.init_kwargs,
+        blocking=_blocking_until_ready, deadline=deadline)
+    dep = dep.options(init_args=init_args, init_kwargs=init_kwargs)
     cls_blob = serialization.pack(dep.func_or_class)
     resources = None
     if dep.ray_actor_options:
@@ -244,12 +284,12 @@ def run(target: Deployment, *, name: str | None = None,
         dep.num_replicas, dep.route_prefix, resources,
         dep.max_concurrent_queries, dep.user_config,
         dep.autoscaling_config,
-    ), timeout=timeout)
+    ), timeout=remaining())
     handle = DeploymentHandle(dep.name)
     if _blocking_until_ready:
-        deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            deps = ray_tpu.get(ctrl.list_deployments.remote(), timeout=30)
+            deps = ray_tpu.get(ctrl.list_deployments.remote(),
+                               timeout=remaining(30.0))
             info = deps.get(dep.name)
             if info and info["live_replicas"] >= info["num_replicas"]:
                 break

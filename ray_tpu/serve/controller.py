@@ -57,6 +57,21 @@ class ServeController:
             ac = None
         with self._lock:
             old = self.deployments.get(name)
+            if old is not None and (
+                old["cls_blob"] == cls_blob
+                and old["init_args"] == init_args
+                and old["init_kwargs"] == init_kwargs
+                and old["route_prefix"] == route_prefix
+                and old["resources"] == resources
+                and old["max_concurrent_queries"] == max_concurrent_queries
+                and old["user_config"] == user_config
+                and old.get("autoscaling_spec") == autoscaling_config
+                and (ac is None) == (old.get("autoscaling") is None)
+                and (ac is not None or old["num_replicas"] == num_replicas)
+            ):
+                # Idempotent redeploy (graph re-runs, shared diamond
+                # children): nothing changed — don't roll healthy replicas.
+                return True
             self.deployments[name] = {
                 "name": name,
                 "cls_blob": cls_blob,
@@ -68,6 +83,7 @@ class ServeController:
                 "max_concurrent_queries": max_concurrent_queries,
                 "user_config": user_config,
                 "autoscaling": ac,
+                "autoscaling_spec": autoscaling_config,
                 # autoscaler bookkeeping: when the load first crossed the
                 # scale-up/-down threshold (None = not currently crossed)
                 "over_since": None,

@@ -169,3 +169,28 @@ def test_delete_deployment(cluster):
     assert "temp_dep" in serve.status()
     serve.delete("temp_dep")
     assert "temp_dep" not in serve.status()
+
+
+def test_deployment_graph_composition(cluster):
+    """Deployment graphs (ref: serve DAG API): a downstream deployment
+    bound as an init arg deploys first and arrives as a live handle."""
+
+    @serve.deployment(name="embedder", num_replicas=1)
+    class Embedder:
+        def __call__(self, text):
+            return {"len": len(text)}
+
+    @serve.deployment(name="ranker", num_replicas=1)
+    class Ranker:
+        def __init__(self, embedder):
+            self.embedder = embedder
+
+        def __call__(self, payload):
+            emb = ray_tpu.get(self.embedder.remote(payload["text"]))
+            return {"score": emb["len"] * 2}
+
+    handle = serve.run(Ranker.bind(Embedder.bind()))
+    out = ray_tpu.get(handle.remote({"text": "hello"}), timeout=120)
+    assert out == {"score": 10}
+    serve.delete("ranker")
+    serve.delete("embedder")
