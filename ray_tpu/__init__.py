@@ -21,6 +21,11 @@ def __getattr__(name):
         from ray_tpu import api
 
         return getattr(api, name)
+    if name in ("GetTimeoutError", "TaskCancelledError", "ActorDiedError",
+                "RayActorError"):
+        from ray_tpu import exceptions
+
+        return getattr(exceptions, name)
     if name == "timeline":
         from ray_tpu.state import timeline
 

@@ -79,6 +79,9 @@ class ActorState:
         # Refs riding the creation spec: held until the actor is DEAD (the
         # spec is replayed on restart, so its args must stay resolvable).
         self.creation_escrow: list[bytes] = []
+        # First return id of the creation task — keys the unflushed-acquire
+        # deferral when the escrow is finally released.
+        self.creation_return_id: bytes | None = None
 
 
 class CoreClient:
@@ -142,6 +145,11 @@ class CoreClient:
         self._key_events: dict[tuple, asyncio.Event] = {}
         # first-return-id → pending record, for ray_tpu.cancel
         self._task_index: dict[bytes, Any] = {}
+        # first-return-id → (worker holder_id, acquires the worker could not
+        # flush before replying): escrow decrefs for those ids wait until the
+        # worker's holder registration is visible in the GCS (release must
+        # never overtake acquire, even across a GCS outage).
+        self._unflushed_replies: dict[bytes, tuple[bytes, set[bytes]]] = {}
         self._closed = False
         # Distributed ref counting (ref: reference_count.h:61): exact local
         # counts here, batched process-level holds to the GCS.
@@ -409,11 +417,19 @@ class CoreClient:
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
             chunk = probe if remaining is None else min(probe, remaining)
-            resolved = self._run(self.raylet.call("store_get", {
-                "object_ids": [k for _, k in missing],
-                "timeout": chunk,
-                "want_data": self.config.remote_object_plane,
-            }), timeout=chunk + 30)
+            try:
+                resolved = self._run(self.raylet.call("store_get", {
+                    "object_ids": [k for _, k in missing],
+                    "timeout": chunk,
+                    "want_data": self.config.remote_object_plane,
+                }), timeout=chunk + 30)
+            except FuturesTimeoutError:
+                # A stalled store_get round must surface as the documented
+                # exception type, not a raw concurrent.futures error.
+                raise GetTimeoutError(
+                    f"object {missing[0][1].hex()[:16]} store_get round "
+                    "stalled (raylet unresponsive)"
+                )
             still: list[tuple[int, bytes]] = []
             for (i, key), (loc, data) in zip(missing, resolved):
                 if loc == "missing":
@@ -829,8 +845,7 @@ class CoreClient:
                 self._task_index.pop(spec.return_ids[0], None)
             # Drop the in-flight escrow; if the caller already released its
             # refs this cascades into the batched GCS release → object GC.
-            for oid in escrow or ():
-                self.refcounter.decref(oid)
+            self._release_escrow(spec, escrow)
 
     def cancel_task(self, oid: bytes, force: bool = False) -> bool:
         """ray_tpu.cancel: queued tasks unqueue and fail with
@@ -1056,6 +1071,69 @@ class CoreClient:
             self._lanes[key] = self._lanes.get(key, 1) - 1
 
 
+    def _release_escrow(self, spec: TaskSpec,
+                        escrow: list[bytes] | None) -> None:
+        """Drop in-flight escrow holds after a task completes. If the worker
+        replied with acquires it could not flush (GCS outage outlasted its
+        reconnect window), the decref for those ids is deferred until the
+        worker's holder registration appears in the GCS ref table — releasing
+        immediately could overtake the acquire once the GCS recovers and free
+        args the task retained (ADVICE r2, worker.py pre-reply flush)."""
+        self._release_escrow_ids(
+            escrow, spec.return_ids[0] if spec.return_ids else None)
+
+    def _release_escrow_ids(self, escrow: list[bytes] | None,
+                            first_return_id: bytes | None) -> None:
+        if not escrow:
+            return
+        unflushed = (self._unflushed_replies.pop(first_return_id, None)
+                     if first_return_id is not None else None)
+        if unflushed is None:
+            for oid in escrow:
+                self.refcounter.decref(oid)
+            return
+        holder_id, pending = unflushed
+        deferred = [oid for oid in escrow if oid in pending]
+        for oid in escrow:
+            if oid not in pending:
+                self.refcounter.decref(oid)
+        if deferred:
+            asyncio.run_coroutine_threadsafe(
+                self._deferred_escrow_release(deferred, holder_id),
+                self._loop)
+
+    async def _deferred_escrow_release(self, oids: list[bytes],
+                                       holder_id: bytes) -> None:
+        """Poll the GCS ref table until `holder_id` is registered for each
+        id (the worker's background flusher landed), then decref. Bounded:
+        after 5× the reconnect window the decref proceeds regardless — by
+        then the worker's flusher has either landed or the worker is gone
+        (holder-death cleanup reclaims its holds anyway)."""
+        remaining = set(oids)
+        deadline = (asyncio.get_running_loop().time()
+                    + 5 * self.config.gcs_reconnect_window_s)
+        while remaining and not self._closed:
+            try:
+                dbg = await self.gcs.call(
+                    "ref_debug", {"object_ids": sorted(remaining)},
+                    timeout=10.0)
+                for oid, info in dbg.items():
+                    if holder_id in info.get("holders", ()):
+                        remaining.discard(oid)
+                        self.refcounter.decref(oid)
+            except Exception:
+                pass
+            if not remaining:
+                return
+            if asyncio.get_running_loop().time() >= deadline:
+                logger.warning(
+                    "deferred escrow release timed out waiting for worker "
+                    "holder registration; releasing %d ids", len(remaining))
+                break
+            await asyncio.sleep(2.0)
+        for oid in remaining:
+            self.refcounter.decref(oid)
+
     async def _safe_release(self, lessor, worker_id, dead=False):
         try:
             await lessor.call("release_lease", {
@@ -1065,6 +1143,9 @@ class CoreClient:
             pass
 
     def _record_returns(self, spec: TaskSpec, reply: dict) -> None:
+        if reply.get("unflushed_acquires") and spec.return_ids:
+            self._unflushed_replies[spec.return_ids[0]] = (
+                reply["ref_holder_id"], set(reply["unflushed_acquires"]))
         for rid, (loc, data) in zip(spec.return_ids, reply["returns"]):
             if loc == "inline":
                 value = serialization.unpack(data)
@@ -1134,6 +1215,7 @@ class CoreClient:
         task_id = TaskID.for_actor_task(ActorID(st.actor_id))
         arg_specs, kw_keys, escrow = self._build_args(args, kwargs)
         st.creation_escrow = escrow
+        st.creation_return_id = ObjectID.for_return(task_id, 0).binary()
         spec = TaskSpec(
             kind=ACTOR_CREATION,
             task_id=task_id.binary(),
@@ -1245,8 +1327,10 @@ class CoreClient:
 
     def _release_creation_escrow(self, st: ActorState) -> None:
         escrow, st.creation_escrow = st.creation_escrow, []
-        for oid in escrow:
-            self.refcounter.decref(oid)
+        # Routes through the unflushed-acquire deferral: a creation reply
+        # that raced a GCS outage may have registered deferred ids under the
+        # creation return id (same hazard as normal-task escrow).
+        self._release_escrow_ids(escrow, st.creation_return_id)
 
     def actor_state(self, actor_id: bytes) -> ActorState:
         st = self._actors.get(actor_id)
@@ -1319,8 +1403,7 @@ class CoreClient:
         finally:
             if spec.return_ids:
                 self._task_index.pop(spec.return_ids[0], None)
-            for oid in escrow or ():
-                self.refcounter.decref(oid)
+            self._release_escrow(spec, escrow)
 
     async def _drive_actor_task_inner(self, st: ActorState,
                                       spec: TaskSpec) -> None:

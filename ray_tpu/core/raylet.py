@@ -44,6 +44,7 @@ class WorkerHandle:
     lease_retriable: bool = True             # current task can retry (OOM kill)
     bundle_key: tuple | None = None          # (pg_id, index) when PG-backed
     started: float = field(default_factory=time.monotonic)
+    leased_at: float = 0.0                   # when the current lease was granted
     proc: Any = None
 
 
@@ -451,11 +452,14 @@ class Raylet:
         if busy:
             retriable = [h for h in busy if h.lease_retriable]
             pool = retriable or busy
-            return max(pool, key=lambda h: h.started)
+            # Rank by lease-grant time, not process spawn time: pooled
+            # workers are reused, so a long-lived worker may be running the
+            # newest task (ADVICE r2).
+            return max(pool, key=lambda h: h.leased_at)
         actors = [h for h in self.workers.values()
                   if h.actor_id is not None and h.conn is not None]
         if actors:
-            return max(actors, key=lambda h: h.started)
+            return max(actors, key=lambda h: h.leased_at)
         return None
 
     async def _memory_monitor_loop(self) -> None:
@@ -705,6 +709,7 @@ class Raylet:
             worker.idle = False
             worker.lease_resources = dict(req.resources)
             worker.lease_retriable = req.retriable
+            worker.leased_at = time.monotonic()
             worker.bundle_key = req.bundle_key
             if req.bundle_key is not None:
                 free = self.pg_bundles[req.bundle_key]["free"]

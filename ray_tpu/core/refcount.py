@@ -84,6 +84,13 @@ class ReferenceCounter:
             with self._lock:
                 self._owned.add(oid)
 
+    def pending_acquire_ids(self) -> list[bytes]:
+        """Acquires the GCS has not (confirmably) seen yet — reported to task
+        submitters when a pre-reply flush cannot land (GCS outage) so their
+        escrow release can wait for this holder's registration."""
+        with self._lock:
+            return sorted(self._pending_acq | self._uncertain)
+
     # ------------------------------------------------------------ counting
 
     def incref(self, oid: bytes) -> None:

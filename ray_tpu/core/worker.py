@@ -310,20 +310,38 @@ class Worker:
         # Flush ref acquires/containments BEFORE replying: the submitter
         # drops its in-flight escrow on reply, and the GCS must already know
         # about any refs this task kept (actor state) or returned — a release
-        # must never overtake its matching acquire. Retried through a GCS
-        # failover window; only a multi-minute GCS outage (in which the
-        # escrow release is equally undeliverable) proceeds unflushed.
+        # must never overtake its matching acquire. Retried briefly (a flush
+        # failure is usually a transient GCS hiccup); if it still can't land,
+        # the reply carries the unflushed acquires so the submitter defers
+        # its escrow decref for those ids until this worker's holder
+        # registration is observed — safe without stalling every completing
+        # task's reply through a long outage.
         from ray_tpu import api
 
         if api._client is not None:
             counter = api._client.refcounter
-            for attempt in range(3):
+            deadline = time.time() + min(
+                10.0, self.config.gcs_reconnect_window_s)
+            delay = 0.5
+            while True:
                 try:
                     await asyncio.to_thread(counter.flush_now, 60.0, True)
                     break
                 except Exception as e:
-                    logger.warning("pre-reply ref flush failed "
-                                   "(attempt %d): %s", attempt + 1, e)
+                    if time.time() >= deadline:
+                        pending = counter.pending_acquire_ids()
+                        if pending:
+                            reply["unflushed_acquires"] = pending
+                            reply["ref_holder_id"] = counter.holder_id
+                        logger.error(
+                            "pre-reply ref flush still failing (%s); "
+                            "replying with %d unflushed acquires",
+                            e, len(pending))
+                        break
+                    logger.warning("pre-reply ref flush failed: %s "
+                                   "(retrying)", e)
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 2.0)
         return reply
 
     def _resolve_args(self, spec: TaskSpec) -> tuple[list, dict]:

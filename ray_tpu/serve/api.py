@@ -228,6 +228,18 @@ class DeploymentHandle:
         # routing state (locks, caches) rebuilds in the destination process.
         return (DeploymentHandle, (self.deployment_name,))
 
+    def __eq__(self, other):
+        # Identity == target deployment (matches __reduce__): the controller
+        # compares init_args on redeploy to detect idempotent graph re-runs —
+        # without this, every _resolve_graph pass builds fresh handle
+        # instances and healthy replicas of shared diamond children would be
+        # rolled on each run.
+        return (isinstance(other, DeploymentHandle)
+                and other.deployment_name == self.deployment_name)
+
+    def __hash__(self):
+        return hash(("DeploymentHandle", self.deployment_name))
+
 
 def _resolve_graph(args, kwargs, *, blocking: bool, deadline: float):
     """Deployment-graph composition (ref: serve DAG API, serve/dag.py):

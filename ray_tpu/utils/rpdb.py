@@ -66,11 +66,20 @@ def _routable_ip(client) -> str:
 
 def set_trace(timeout_s: float = 300.0):
     """Breakpoint: block for a `ray_tpu debug` attach, then drop into pdb
-    over the connection. Continues silently if nobody attaches in time."""
+    over the connection. Continues silently if nobody attaches in time.
+
+    Binds to 127.0.0.1 by default — an open pdb socket is arbitrary code
+    execution, so cross-node attach (routable-IP bind) requires the explicit
+    `RAY_TPU_DEBUGGER_EXTERNAL=1` opt-in (attach via SSH tunnel otherwise),
+    mirroring the reference's --ray-debugger-external flag.
+    """
     import pdb
 
     client = _kv()
-    bind_ip = _routable_ip(client)
+    if os.environ.get("RAY_TPU_DEBUGGER_EXTERNAL") == "1":
+        bind_ip = _routable_ip(client)
+    else:
+        bind_ip = "127.0.0.1"
     srv = socket.socket()
     srv.bind((bind_ip, 0))
     srv.listen(1)
