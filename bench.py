@@ -195,8 +195,25 @@ def main() -> None:
             cfg = gpt.GPTConfig.tiny()
             B, S = 2 * n_dev, 128
         else:
-            cfg = gpt.GPTConfig.gpt2_124m(max_seq=1024, remat=True)
-            B, S = 8 * n_dev, 1024
+            # Tuned defaults (see BENCH.md ablation, measured on v5e):
+            # the in-repo Pallas flash-attention kernel (bf16 MXU dots,
+            # 512x512 blocks), remat ON (with the fast kernel the recompute
+            # is cheaper than the HBM traffic of storing activations —
+            # 83.8k tok/s vs 82.6k off), B=8/chip (B=16/32 amortize no
+            # better). Every knob is env-overridable for ablations
+            # (BENCH_ATTN / BENCH_REMAT / BENCH_BATCH / BENCH_SEQ /
+            # BENCH_CHUNK / BENCH_MODEL).
+            model_name = os.environ.get("BENCH_MODEL", "gpt2_124m")
+            S = int(os.environ.get("BENCH_SEQ", "1024"))
+            chunk = int(os.environ.get("BENCH_CHUNK", "0")) or None
+            cfg = gpt.GPTConfig.by_name(
+                model_name,
+                max_seq=S,
+                remat=os.environ.get("BENCH_REMAT", "1") == "1",
+                attn_impl=os.environ.get("BENCH_ATTN", "flash"),
+                loss_chunk=chunk,
+            )
+            B = int(os.environ.get("BENCH_BATCH", str(8 * n_dev)))
         optimizer = optax.adamw(3e-4, weight_decay=0.1)
         params, opt_state, step = spmd.build_training(
             cfg, mesh, optimizer, jax.random.key(0)

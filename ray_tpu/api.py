@@ -556,6 +556,22 @@ class RuntimeContext:
     def is_initialized(self):
         return is_initialized()
 
+    def get_actor_id(self) -> str | None:
+        """Hex id of the actor executing the current code, or None outside
+        an actor (ref: runtime_context.py get_actor_id)."""
+        from ray_tpu.core import execution_context
+        from ray_tpu.core.ids import ActorID
+
+        aid = execution_context.current_actor_id.get()
+        return ActorID(aid).hex() if aid is not None else None
+
+    def get_task_id(self) -> str | None:
+        from ray_tpu.core import execution_context
+        from ray_tpu.core.ids import TaskID
+
+        tid = execution_context.current_task_id.get()
+        return TaskID(tid).hex() if tid is not None else None
+
 
 def get_runtime_context() -> RuntimeContext:
     return RuntimeContext()

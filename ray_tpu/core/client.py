@@ -1084,10 +1084,13 @@ class CoreClient:
 
     def _release_escrow_ids(self, escrow: list[bytes] | None,
                             first_return_id: bytes | None) -> None:
-        if not escrow:
-            return
+        # Pop the unflushed-reply entry even when escrow is empty (a
+        # no-ref-arg task during a GCS outage still records one): the map
+        # must not grow unboundedly.
         unflushed = (self._unflushed_replies.pop(first_return_id, None)
                      if first_return_id is not None else None)
+        if not escrow:
+            return
         if unflushed is None:
             for oid in escrow:
                 self.refcounter.decref(oid)
@@ -1556,6 +1559,10 @@ class CoreClient:
         task_id = TaskID.for_actor_task(ActorID(st.actor_id))
         spec.task_id = task_id.binary()
         spec.return_ids = [ObjectID.for_return(task_id, 0).binary()]
+        # The unflushed-acquire deferral keys off the creation return id —
+        # track the replayed spec's id or the eventual escrow release would
+        # look up the stale original and skip the deferral.
+        st.creation_return_id = spec.return_ids[0]
         st.dead = False
         try:
             await self._place_actor(st, spec, node_address, node_id)
@@ -1566,10 +1573,23 @@ class CoreClient:
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
         st = self.actor_state(actor_id)
-        resp = self._run(self.gcs.call("kill_actor", {"actor_id": actor_id}))
-        st.dead = True
-        self._release_creation_escrow(st)
-        st.death_cause = "killed"
+        resp = self._run(self.gcs.call("kill_actor", {
+            "actor_id": actor_id, "no_restart": no_restart}))
+        restarting = isinstance(resp, dict) and resp.get("restarting")
+        if restarting:
+            # Actor FSM will replay the creation task: keep the creation
+            # escrow (the spec's args must stay resolvable) and let the
+            # RESTARTING→ALIVE pubsub events drive local state.
+            st.address = None
+            st.ready.clear()
+            st.restarting = True
+            asyncio.run_coroutine_threadsafe(
+                self._ensure_actor_restart(st, "killed with no_restart=False"),
+                self._loop)
+        else:
+            st.dead = True
+            self._release_creation_escrow(st)
+            st.death_cause = "killed"
         addr = resp.get("address") if isinstance(resp, dict) else None
         addr = addr or st.address
         if addr:

@@ -578,6 +578,22 @@ class GcsServer:
         info = self.actors.get(p["actor_id"])
         if info is None:
             return {"ok": False}
+        addr = info.address
+        restartable = (info.max_restarts == -1
+                       or info.num_restarts < info.max_restarts)
+        if (not p.get("no_restart", True) and restartable
+                and info.state != DEAD):
+            # ray.kill(no_restart=False) parity: the process dies but the
+            # actor FSM restarts it (replaying the creation spec) — used by
+            # e.g. serve controller FT tests.
+            info.num_restarts += 1
+            info.state = RESTARTING
+            info.address = None
+            info.placing = False
+            self._wal_actor(info)
+            self.publish("actor", {"actor_id": p["actor_id"],
+                                   "state": RESTARTING, "cause": "killed"})
+            return {"ok": True, "address": addr, "restarting": True}
         info.state = DEAD
         info.death_cause = "ray_tpu.kill"
         if info.name:
@@ -585,7 +601,7 @@ class GcsServer:
         self.publish("actor", {"actor_id": p["actor_id"], "state": DEAD,
                                "cause": "killed"})
         self._wal_actor(info)
-        return {"ok": True, "address": info.address}
+        return {"ok": True, "address": addr}
 
     async def _get_actor(self, conn, p):
         actor_id = p.get("actor_id")

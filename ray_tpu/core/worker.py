@@ -21,7 +21,7 @@ import time
 import traceback
 from typing import Any
 
-from ray_tpu.core import rpc, serialization
+from ray_tpu.core import execution_context, rpc, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, ObjectID, WorkerID
 from ray_tpu.core.task_spec import ACTOR_CREATION, ACTOR_TASK, NORMAL_TASK, TaskSpec
@@ -237,10 +237,11 @@ class Worker:
         rt = self.actors.get(p["actor_id"])
         if rt is None:
             return {"ok": False}
-        if p.get("no_restart", True) or True:
-            # Actor death == worker process death (matches reference:
-            # one actor per worker process).
-            asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        # Actor death == worker process death regardless of no_restart
+        # (matches reference: one actor per worker process; the restart, if
+        # any, replays the creation spec on a FRESH worker — the GCS decided
+        # that before this RPC was sent).
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return {"ok": True}
 
     # ------------------------------------------------------------ execution
@@ -325,7 +326,11 @@ class Worker:
             delay = 0.5
             while True:
                 try:
-                    await asyncio.to_thread(counter.flush_now, 60.0, True)
+                    # Per-attempt timeout bounded by the remaining deadline:
+                    # a hung (not failing-fast) GCS connection must not hold
+                    # the reply past the fallback window.
+                    budget = max(1.0, deadline - time.time())
+                    await asyncio.to_thread(counter.flush_now, budget, True)
                     break
                 except Exception as e:
                     if time.time() >= deadline:
@@ -367,6 +372,7 @@ class Worker:
     def _run_normal_task(self, spec: TaskSpec):
         self.current_task_id = spec.task_id
         self._running[spec.task_id] = ("thread", threading.get_ident())
+        execution_context.current_task_id.set(spec.task_id)
         restore = None
         try:
             from ray_tpu.core.runtime_env import apply_runtime_env
@@ -398,6 +404,7 @@ class Worker:
             apply_runtime_env(spec.runtime_env)
             cls = serialization.unpack(spec.fn_blob)
             args, kwargs = self._resolve_args(spec)
+            execution_context.current_actor_id.set(spec.actor_id)
             instance = cls(*args, **kwargs)
             rt = ActorRuntime(spec.actor_id, instance, spec.max_concurrency,
                               spec.concurrency_groups)
@@ -410,6 +417,8 @@ class Worker:
     def _run_actor_task(self, rt: ActorRuntime, spec: TaskSpec):
         self.current_task_id = spec.task_id
         self._running[spec.task_id] = ("thread", threading.get_ident())
+        execution_context.current_actor_id.set(spec.actor_id)
+        execution_context.current_task_id.set(spec.task_id)
         try:
             method = getattr(rt.instance, spec.method_name)
             args, kwargs = self._resolve_args(spec)
@@ -441,6 +450,8 @@ class Worker:
         done: _cf.Future = _cf.Future()
 
         async def runner():
+            execution_context.current_actor_id.set(spec.actor_id)
+            execution_context.current_task_id.set(spec.task_id)
             async with rt._asem:
                 return await method(*args, **kwargs)
 

@@ -41,6 +41,12 @@ class GPTConfig:
     tie_embeddings: bool = True
     remat: bool = False              # jax.checkpoint each block (for big models)
     attn_impl: str = "xla"           # "xla" | "flash" (pallas) | "ring" (sp-sharded)
+    # Cross-entropy head chunking: compute logits/loss over sequence chunks of
+    # this many tokens (bounds the fp32 [B, chunk, V] materialization instead
+    # of [B, S, V] — at B=32, S=1024, V=50k the unchunked fp32 logits alone
+    # are 6.6 GB). None = single full-sequence head. Requires sp=1 (the chunk
+    # scan slices the sequence axis).
+    loss_chunk: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -56,17 +62,19 @@ class GPTConfig:
 
     @classmethod
     def gptj_6b(cls, **kw) -> "GPTConfig":
+        kw.setdefault("remat", True)
         return cls(
             d_model=4096, n_layers=28, n_heads=16, d_ff=16384,
-            rotary_dim=64, tie_embeddings=False, remat=True, **kw
+            rotary_dim=64, tie_embeddings=False, **kw
         )
 
     @classmethod
     def opt_1_3b(cls, **kw) -> "GPTConfig":
         """OPT-1.3B-class decoder (BASELINE config 5 serving target)."""
+        kw.setdefault("remat", True)
         return cls(
             d_model=2048, n_layers=24, n_heads=32, d_ff=8192,
-            rotary_dim=64, tie_embeddings=False, remat=True, **kw
+            rotary_dim=64, tie_embeddings=False, **kw
         )
 
     @classmethod
@@ -237,13 +245,13 @@ _BLOCK_KEYS = (
 )
 
 
-def forward(
+def forward_hidden(
     params: dict[str, jax.Array],
     tokens: jax.Array,
     cfg: GPTConfig,
     mesh=None,
 ) -> jax.Array:
-    """tokens: [B, S] int32 → logits [B, S, V] (cfg.dtype).
+    """tokens: [B, S] int32 → final-norm hidden states [B, S, D] (cfg.dtype).
 
     `mesh` is only consulted when cfg.attn_impl == "ring" (the sp-sharded
     ring-attention path runs in an explicit shard_map over it).
@@ -257,10 +265,24 @@ def forward(
         return fn(x, layer), None
 
     x, _ = jax.lax.scan(body, x, stacked)
-    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    return _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+
+
+def _head_matrix(params, cfg: GPTConfig):
     head = params["lm_head"] if not cfg.tie_embeddings else params["wte"].T
+    return head.astype(cfg.dtype)
+
+
+def forward(
+    params: dict[str, jax.Array],
+    tokens: jax.Array,
+    cfg: GPTConfig,
+    mesh=None,
+) -> jax.Array:
+    """tokens: [B, S] int32 → logits [B, S, V] (fp32)."""
+    x = forward_hidden(params, tokens, cfg, mesh)
     logits = jnp.einsum(
-        "bsd,dv->bsv", x, head.astype(cfg.dtype),
+        "bsd,dv->bsv", x, _head_matrix(params, cfg),
         preferred_element_type=jnp.float32,
     )
     return logits
@@ -316,11 +338,46 @@ def loss_fn(
     cfg: GPTConfig,
     mesh=None,
 ) -> jax.Array:
-    """Mean next-token cross-entropy. tokens/targets: [B, S] int32."""
-    logits = forward(params, tokens, cfg, mesh)  # fp32
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    """Mean next-token cross-entropy. tokens/targets: [B, S] int32.
+
+    With cfg.loss_chunk set, the vocab projection + CE run under a scanned
+    sequence-chunk loop with rematerialization: only one fp32 [B, chunk, V]
+    logits block is live at a time (fwd AND bwd — the chunk recomputes its
+    logits in the backward pass, and the head gradient accumulates across
+    chunks inside the scan's own autodiff).
+    """
+    x = forward_hidden(params, tokens, cfg, mesh)
+    head = _head_matrix(params, cfg)
+    if cfg.loss_chunk is None or tokens.shape[1] <= cfg.loss_chunk:
+        logits = jnp.einsum(
+            "bsd,dv->bsv", x, head, preferred_element_type=jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+    S = tokens.shape[1]
+    C = cfg.loss_chunk
+    if S % C != 0:
+        raise ValueError(f"seq len {S} not divisible by loss_chunk {C}")
+    xs = x.reshape(x.shape[0], S // C, C, x.shape[-1])
+    ts = targets.reshape(targets.shape[0], S // C, C)
+
+    @jax.checkpoint
+    def chunk_ce(x_c, t_c):
+        logits = jnp.einsum(
+            "bcd,dv->bcv", x_c, head, preferred_element_type=jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return jnp.sum(logz - gold)
+
+    def body(tot, chunk):
+        x_c, t_c = chunk
+        return tot + chunk_ce(x_c, t_c), None
+
+    total, _ = jax.lax.scan(
+        body, jnp.zeros((), jnp.float32),
+        (jnp.swapaxes(xs, 0, 1), jnp.swapaxes(ts, 0, 1)))
+    return total / (targets.shape[0] * S)
 
 
 def num_params(cfg: GPTConfig) -> int:

@@ -78,11 +78,14 @@ def _fwd_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, K]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, K]
+        # MXU dots run in the input dtype (bf16) with fp32 accumulate —
+        # upcasting the operands would silently drop the MXU into its ~4x
+        # slower fp32 mode. Softmax statistics stay fp32.
+        q = q_ref[0, 0]                      # [bq, K]
+        k = k_ref[0, 0]                      # [bk, K]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [bq, bk]
+        ) * sm_scale  # [bq, bk] fp32
 
         mask = _block_mask(iq, ik, causal=causal, kv_len=kv_len,
                            block_q=block_q, block_kv=block_kv)
@@ -91,12 +94,13 @@ def _fwd_kernel(
         m_prev = m_ref[...]                       # [bq, LANES] (uniform rows)
         row_max = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, row_max)      # [bq, LANES]
-        p = jnp.exp(s - m_new[:, :1])             # [bq, bk]
+        p = jnp.exp(s - m_new[:, :1])             # [bq, bk] fp32
         corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # [bq, 1]
         l_new = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         acc_ref[...] = acc_ref[...] * corr + pv
         m_ref[...] = m_new
@@ -113,9 +117,15 @@ def _fwd_kernel(
         l = l_ref[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
-        m = m_ref[:, 0]
-        lval = l_ref[:, 0]
-        lse = jnp.where(lval == 0.0, NEG_INF, m + jnp.log(jnp.where(lval == 0.0, 1.0, lval)))
+        # lse is stored lane-broadcast as [bq, LANES]: TPU pallas requires
+        # the last two block dims to be (8k, 128m)-tiled, so a [bq]-shaped
+        # row output cannot lower (same layout as the official kernel's
+        # save_residuals l/m outputs).
+        m = m_ref[...]
+        lval = l_ref[...]
+        lse = jnp.where(
+            lval == 0.0, NEG_INF,
+            m + jnp.log(jnp.where(lval == 0.0, 1.0, lval)))
         lse_ref[0, 0] = lse
 
 
@@ -147,11 +157,12 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, K), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, iq, ik: (b, h, iq)),
+            pl.BlockSpec((1, 1, bq, _LANES),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S_pad, K), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S_pad), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S_pad, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),
@@ -160,7 +171,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_kv, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
-    return o[:, :, :S], lse[:, :, :S]
+    return o[:, :, :S], lse[:, :, :S, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -180,12 +191,12 @@ def _dq_kernel(
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]      # [bq, 1]
-        delta = delta_ref[0, 0][:, None]  # [bq, 1]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]      # [bq, 1] (lane-broadcast input)
+        delta = delta_ref[0, 0][:, :1]  # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
@@ -195,7 +206,7 @@ def _dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -224,25 +235,26 @@ def _dkv_kernel(
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
         mask = _block_mask(iq, ik, causal=causal, kv_len=kv_len,
                            block_q=block_q, block_kv=block_kv)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk] fp32
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale    # [bq, bk]
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)    # [bq, bk]
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -285,9 +297,15 @@ def _bwd_impl(q, k, v, o, lse, do, dlse, causal, sm_scale, block_q, block_kv, in
         k, v = pad4(k, T_pad), pad4(v, T_pad)
     nq, nk = S_pad // bq, T_pad // bk
 
+    # Row vectors enter the kernels lane-broadcast ([B,H,S,LANES]): TPU
+    # pallas cannot lower a block whose last two dims aren't (8k, 128m).
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, _LANES))
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
+
     q_spec = pl.BlockSpec((1, 1, bq, K), lambda b, h, iq, ik: (b, h, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, K), lambda b, h, iq, ik: (b, h, ik, 0))
-    row_spec = pl.BlockSpec((1, 1, bq), lambda b, h, iq, ik: (b, h, iq))
+    row_spec = pl.BlockSpec((1, 1, bq, _LANES),
+                            lambda b, h, iq, ik: (b, h, iq, 0))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -305,7 +323,8 @@ def _bwd_impl(q, k, v, o, lse, do, dlse, causal, sm_scale, block_q, block_kv, in
     # kv-major grid: program_id(2)=ik, program_id(3)=iq.
     q_spec2 = pl.BlockSpec((1, 1, bq, K), lambda b, h, ik, iq: (b, h, iq, 0))
     kv_spec2 = pl.BlockSpec((1, 1, bk, K), lambda b, h, ik, iq: (b, h, ik, 0))
-    row_spec2 = pl.BlockSpec((1, 1, bq), lambda b, h, ik, iq: (b, h, iq))
+    row_spec2 = pl.BlockSpec((1, 1, bq, _LANES),
+                             lambda b, h, ik, iq: (b, h, iq, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal, kv_len=T,
@@ -362,8 +381,8 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: float | None = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int = 512,
+    block_kv: int = 512,
     return_lse: bool = False,
     interpret: bool | None = None,
 ):

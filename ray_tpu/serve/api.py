@@ -55,6 +55,9 @@ def _get_controller(create: bool = False):
 
         ctrl = ray_tpu.remote(ServeController).options(
             name=CONTROLLER_NAME, get_if_exists=True, max_concurrency=16,
+            # Controller FT: auto-restart; __init__ restores the GCS KV
+            # checkpoint and the reconcile loop re-adopts live replicas.
+            max_restarts=-1,
         ).remote()
         return ctrl
 

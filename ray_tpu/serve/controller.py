@@ -17,10 +17,29 @@ import time
 from typing import Any
 
 ROUTES_CHANNEL = "serve_routes"
+CKPT_NS = "serve"
+CKPT_KEY = b"controller_ckpt"
+
+# Record fields persisted across controller restarts. Runtime bookkeeping
+# (over/under_since) deliberately excluded — autoscaler timers restart clean.
+_CKPT_FIELDS = (
+    "name", "cls_blob", "init_args", "init_kwargs", "num_replicas",
+    "route_prefix", "resources", "max_concurrent_queries", "user_config",
+    "autoscaling", "autoscaling_spec", "generation",
+)
 
 
 class ServeController:
-    """Runs as a named detached actor ("ray_tpu_serve_controller")."""
+    """Runs as a named detached actor ("ray_tpu_serve_controller").
+
+    Fault-tolerant: desired state (deployments, versions, target replica
+    counts) AND the current replica handle set are checkpointed to the GCS
+    KV on every mutation (ref: serve/_private/storage/kv_store.py +
+    deployment_state.py:1767 checkpointing). On restart (the actor is
+    created with max_restarts) the checkpoint is restored and the reconcile
+    loop adopts still-live replicas (health probe) / replaces dead ones —
+    routes keep serving through a controller kill -9.
+    """
 
     def __init__(self):
         # name → deployment record
@@ -28,8 +47,75 @@ class ServeController:
         self.version = 0
         self._lock = threading.Lock()
         self._stop = False
+        self._ckpt_seq = 0          # monotonic: drop out-of-order KV writes
+        self._ckpt_write_lock = threading.Lock()
+        self._restore()
         self._reconciler = threading.Thread(target=self._loop, daemon=True)
         self._reconciler.start()
+
+    # ------------------------------------------------------- checkpointing
+
+    def _restore(self) -> None:
+        from ray_tpu import api as _api
+        from ray_tpu.core import serialization
+
+        try:
+            raw = _api._ensure_client().kv_get(CKPT_NS, CKPT_KEY)
+        except Exception:
+            raw = None
+        if not raw:
+            return
+        try:
+            snap = serialization.unpack(raw)
+        except Exception:
+            return
+        for name, rec in snap.get("deployments", {}).items():
+            d = {k: rec[k] for k in _CKPT_FIELDS}
+            d["over_since"] = None
+            d["under_since"] = None
+            # Pickled (actor_id, handle) pairs: dead ones are filtered by
+            # the first reconcile health probe; live ones are adopted as-is.
+            d["replicas"] = rec["replicas"]
+            self.deployments[name] = d
+        # Version must move FORWARD past anything handles may have cached —
+        # including bumps the best-effort async checkpoint writer lost before
+        # the crash. A generous jump is safe (handles only compare order);
+        # too small a jump leaves handles with pushed_version > version,
+        # force-refreshing on every request.
+        self.version = snap.get("version", 0) + 1024
+
+    def _checkpoint_locked(self) -> None:
+        """Snapshot under the lock; write to the GCS KV off-thread (a slow
+        GCS must not stall deploy/reconcile). Last-writer-wins guarded by a
+        sequence number so a delayed older write can't clobber newer state."""
+        from ray_tpu.core import serialization
+
+        self._ckpt_seq += 1
+        seq = self._ckpt_seq
+        snap = {
+            "version": self.version,
+            "deployments": {
+                name: {**{k: d[k] for k in _CKPT_FIELDS},
+                       "replicas": list(d["replicas"])}
+                for name, d in self.deployments.items()
+            },
+        }
+        blob = serialization.pack(snap)
+
+        def _write():
+            from ray_tpu import api as _api
+
+            try:
+                with self._ckpt_write_lock:     # one writer in flight
+                    with self._lock:
+                        if seq != self._ckpt_seq:
+                            return  # a newer snapshot supersedes this one
+                    _api._ensure_client().kv_put(
+                        CKPT_NS, CKPT_KEY, bytes(blob))
+            except Exception:
+                pass
+
+        threading.Thread(target=_write, daemon=True).start()
 
     # ------------------------------------------------------------ API
 
@@ -95,6 +181,7 @@ class ServeController:
                 # config/code changed → roll all replicas
                 self._drain_replicas(self.deployments[name], all=True)
             self._bump_version_locked()
+            self._checkpoint_locked()
         self._reconcile_once()
         return True
 
@@ -104,6 +191,7 @@ class ServeController:
             if d:
                 self._drain_replicas(d, all=True)
             self._bump_version_locked()
+            self._checkpoint_locked()
         return True
 
     def get_routing(self, known_version: int = -1) -> dict | None:
@@ -119,6 +207,14 @@ class ServeController:
                     "max_concurrent_queries": d["max_concurrent_queries"],
                 }
         return {"version": self.version, "routes": routes}
+
+    def is_member(self, deployment: str, actor_id_hex: str) -> bool:
+        """Replica orphan check (see replica._membership_loop)."""
+        with self._lock:
+            d = self.deployments.get(deployment)
+            if d is None:
+                return False
+            return any(aid == actor_id_hex for aid, _h in d["replicas"])
 
     def list_deployments(self) -> dict:
         with self._lock:
@@ -139,6 +235,7 @@ class ServeController:
                 self._drain_replicas(d, all=True)
             self.deployments.clear()
             self._bump_version_locked()
+            self._checkpoint_locked()
         return True
 
     # ------------------------------------------------------------ reconcile
@@ -264,9 +361,10 @@ class ServeController:
                     replica_cls = ray_tpu.remote(Replica).options(**opts)
                     h = replica_cls.remote(
                         d["cls_blob"], d["init_args"], d["init_kwargs"],
-                        d["user_config"],
+                        d["user_config"], name,
                     )
                     d["replicas"].append((h._actor_id.hex(), h))
                     changed = True
                 if changed:
                     self._bump_version_locked()
+                    self._checkpoint_locked()

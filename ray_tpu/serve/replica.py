@@ -15,7 +15,7 @@ from ray_tpu.core import serialization
 
 class Replica:
     def __init__(self, cls_blob: bytes, init_args: tuple, init_kwargs: dict,
-                 user_config: Any = None):
+                 user_config: Any = None, deployment_name: str | None = None):
         target = serialization.unpack(cls_blob)
         if isinstance(target, type):
             self.callable = target(*init_args, **(init_kwargs or {}))
@@ -26,6 +26,49 @@ class Replica:
         self._processed = 0
         if user_config is not None:
             self.reconfigure(user_config)
+        if deployment_name is not None:
+            self._deployment_name = deployment_name
+            # Read the actor id HERE: __init__ runs in the creation task's
+            # context (the ContextVar is set); a fresh thread starts with an
+            # empty context and would see None.
+            from ray_tpu import api as _api
+
+            my_id = _api.get_runtime_context().get_actor_id()
+            t = threading.Thread(
+                target=self._membership_loop, args=(my_id,), daemon=True)
+            t.start()
+
+    def _membership_loop(self, my_id: str | None) -> None:
+        """Orphan self-drain: a replica spawned right before a controller
+        crash may be missing from the restored checkpoint — the restarted
+        controller spawns replacements and this actor would serve (and hold
+        resources) forever. Each replica therefore periodically asks the
+        controller whether it is still a member of its deployment; two
+        consecutive "no"s → exit. Controller unreachable (dead / mid-restart)
+        → keep serving: routes must survive a controller outage."""
+        import os
+        import time
+
+        import ray_tpu
+
+        if my_id is None:
+            return  # not running inside an actor (unit tests) — no verdicts
+        strikes = 0
+        while True:
+            time.sleep(5.0)
+            try:
+                from ray_tpu.serve.api import CONTROLLER_NAME
+
+                ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+                ok = ray_tpu.get(
+                    ctrl.is_member.remote(self._deployment_name, my_id),
+                    timeout=10)
+            except Exception:
+                strikes = 0  # no verdict without a healthy controller
+                continue
+            strikes = strikes + 1 if not ok else 0
+            if strikes >= 2:
+                os._exit(0)
 
     def health(self) -> bool:
         return True

@@ -109,3 +109,65 @@ def test_push_invalidation_beats_ttl(cluster):
         time.sleep(0.2)
     assert val == "b", "old handle never saw the rolled deployment"
     serve.delete("versioned")
+
+
+def test_controller_fault_tolerance_mid_traffic(cluster):
+    """Kill the controller mid-traffic: routes keep serving (handles route
+    from their cached table; replicas stay alive), the restarted controller
+    restores its GCS-KV checkpoint, re-adopts the SAME live replicas, and
+    reconcile converges — VERDICT r2 item 3. Ref:
+    /root/reference/python/ray/serve/_private/deployment_state.py:1767."""
+
+    @serve.deployment(name="durable", num_replicas=2)
+    class Sticky:
+        def __init__(self):
+            import os
+            self.token = os.urandom(4).hex()
+
+        def __call__(self, _x):
+            return self.token
+
+    handle = serve.run(Sticky.bind(), _blocking_until_ready=True)
+    tokens_before = set()
+    for _ in range(12):
+        tokens_before.add(ray_tpu.get(handle.remote(0), timeout=60))
+    assert len(tokens_before) == 2  # both replicas seen
+
+    ctrl = ray_tpu.get_actor("ray_tpu_serve_controller")
+    stop = threading.Event()
+    errors = []
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                assert ray_tpu.get(handle.remote(0), timeout=60) in tokens_before
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    ray_tpu.kill(ctrl, no_restart=False)  # controller dies; actor FSM restarts it
+    time.sleep(4.0)  # traffic continues through death + restart
+    stop.set()
+    t.join(timeout=30)
+    assert not errors, f"traffic failed during controller outage: {errors[:2]}"
+
+    # Restarted controller must have restored state and adopted (not rolled)
+    # the live replicas: same tokens, still exactly 2 replicas.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if _live("durable") == 2:
+                break
+        except Exception:  # controller mid-restart
+            pass
+        time.sleep(0.3)
+    assert _live("durable") == 2
+    tokens_after = {ray_tpu.get(handle.remote(0), timeout=60)
+                    for _ in range(12)}
+    assert tokens_after == tokens_before, (
+        f"replicas were rolled on controller restart: "
+        f"{tokens_before} -> {tokens_after}")
+    serve.delete("durable")
