@@ -1,0 +1,35 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from ray_tpu.models import gpt
+from ray_tpu.models.decode import init_kv_cache, prefill, decode_step
+
+cfg = gpt.GPTConfig.by_name("opt_1_3b")
+print("init params...", flush=True)
+t0 = time.perf_counter()
+params = jax.tree.map(
+    lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+    gpt.init_params(cfg, jax.random.key(0)))
+jax.tree.leaves(params)[0].block_until_ready()
+print(f"  {time.perf_counter()-t0:.1f}s", flush=True)
+
+t0 = time.perf_counter()
+cache = init_kv_cache(cfg, 8, 1024)
+print(f"cache {time.perf_counter()-t0:.1f}s", flush=True)
+
+padded = np.zeros((1, 64), np.int32); padded[0, :48] = 1
+t0 = time.perf_counter()
+last, cache = prefill(cfg, params, jnp.asarray(padded), cache,
+                      jnp.int32(0), jnp.int32(48))
+print("prefill compile+run", time.perf_counter()-t0, "s; last[0:3]",
+      np.asarray(last)[:3], flush=True)
+
+toks = np.zeros(8, np.int32); pos = np.zeros(8, np.int32); pos[0] = 48
+t0 = time.perf_counter()
+logits, cache = decode_step(cfg, params, jnp.asarray(toks), cache, jnp.asarray(pos))
+print("decode compile+run", time.perf_counter()-t0, "s", flush=True)
+t0 = time.perf_counter()
+for _ in range(20):
+    logits, cache = decode_step(cfg, params, jnp.asarray(toks), cache, jnp.asarray(pos))
+float(np.asarray(logits).sum())
+print("20 decode steps", (time.perf_counter()-t0)/20*1e3, "ms/step", flush=True)

@@ -1,0 +1,21 @@
+import time
+import numpy as np
+from ray_tpu.utils.platform import force_cpu_devices
+force_cpu_devices(1)
+import ray_tpu
+ray_tpu.init(num_cpus=4)
+from ray_tpu.rllib import PPOConfig
+
+cfg = (PPOConfig()
+       .environment("PixelCatchSmall-v0", seed=0)
+       .rollouts(num_envs_per_worker=16, rollout_fragment_length=64)
+       .training(num_sgd_iter=4, sgd_minibatch_size=256,
+                 lr=2.5e-4, entropy_coeff=0.01, model_conv="nature"))
+algo = cfg.build()
+t0 = time.perf_counter()
+for it in range(60):
+    res = algo.train()
+    print(f"it={it} t={time.perf_counter()-t0:.0f}s steps={res['timesteps_total']} "
+          f"ret={res.get('episode_return_mean')}", flush=True)
+algo.stop()
+ray_tpu.shutdown()

@@ -214,7 +214,12 @@ def main() -> None:
                 loss_chunk=chunk,
             )
             B = int(os.environ.get("BENCH_BATCH", str(8 * n_dev)))
-        optimizer = optax.adamw(3e-4, weight_decay=0.1)
+        # BENCH_OPT=adafactor for tiers whose fp32 adam moments don't fit
+        # one chip (see train/memory_audit.py + tests/test_sharding_audit).
+        if os.environ.get("BENCH_OPT", "adamw") == "adafactor":
+            optimizer = optax.adafactor(3e-4)
+        else:
+            optimizer = optax.adamw(3e-4, weight_decay=0.1)
         params, opt_state, step = spmd.build_training(
             cfg, mesh, optimizer, jax.random.key(0)
         )

@@ -31,6 +31,8 @@ def main() -> None:
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--prompt-len", type=int, default=48)
     ap.add_argument("--n-slots", type=int, default=8)
+    ap.add_argument("--bf16", action="store_true",
+                    help="serve bf16 weights (halves decode HBM traffic)")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
 
@@ -44,7 +46,18 @@ def main() -> None:
     from ray_tpu.serve.llm import LLMEngine
 
     cfg = gpt.GPTConfig.by_name(args.model)
-    engine = LLMEngine(cfg, n_slots=args.n_slots, max_len=1024)
+    params = None
+    if args.bf16:
+        # Serving-standard bf16 weights: decode is HBM-bound, fp32 masters
+        # would double the per-token weight traffic.
+        import jax
+        import jax.numpy as jnp
+
+        params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a,
+            gpt.init_params(cfg, jax.random.key(0)))
+    engine = LLMEngine(cfg, params, n_slots=args.n_slots, max_len=1024)
     engine.start()
     rng = np.random.default_rng(0)
 

@@ -57,12 +57,30 @@ class BatchPredictor:
         return cls(checkpoint, predictor_cls, **kwargs)
 
     def predict(self, dataset, *, batch_size: int | None = None,
-                batch_format: str = "numpy"):
-        """→ Dataset of predictions (lazy; executes with the dataset plan)."""
+                batch_format: str = "numpy", compute=None):
+        """→ Dataset of predictions (lazy; executes with the dataset plan).
+
+        With `compute=ActorPoolStrategy(...)` inference runs on a reusable
+        actor pool: the predictor builds once per ACTOR (weights load +
+        jit compile amortize over every block the actor processes) instead
+        of relying on the per-process cache of task workers.
+        """
         ckpt = self.checkpoint
         predictor_cls = self.predictor_cls
         kwargs = self.predictor_kwargs
         cache_key = self._cache_key
+
+        if compute is not None:
+            class _PredictorTransform:
+                def __init__(self):
+                    self._p = predictor_cls.from_checkpoint(ckpt, **kwargs)
+
+                def __call__(self, batch):
+                    return self._p.predict_batch(batch)
+
+            return dataset.map_batches(
+                _PredictorTransform, batch_size=batch_size,
+                batch_format=batch_format, compute=compute)
 
         def infer(batch):
             from ray_tpu.air.batch_predictor import _PREDICTOR_CACHE

@@ -84,6 +84,15 @@ class ActorState:
         self.creation_return_id: bytes | None = None
 
 
+def _env_lease_fields(spec) -> dict:
+    """Lease-request fields for a spec's pip runtime env: the raylet keys
+    its worker pool by env digest and builds the venv from the recipe."""
+    pe = (spec.runtime_env or {}).get("pip_env") if spec.runtime_env else None
+    if pe:
+        return {"runtime_env_key": pe["digest"], "pip_env": pe}
+    return {}
+
+
 class CoreClient:
     def __init__(
         self,
@@ -768,12 +777,14 @@ class CoreClient:
         saturation means waiting, not failure (cluster_task_manager.cc)."""
         raylet = self.raylet
         raylet_addr = self.raylet_address
+        env_fields = _env_lease_fields(spec)
         for _hop in range(8):
             grant = await raylet.call("request_lease", {
                 "resources": spec.resources,
                 "strategy": spec.scheduling_strategy,
                 "timeout": self.config.lease_timeout_s,
                 "retriable": spec.max_retries > 0,
+                **env_fields,
             }, timeout=self.config.lease_timeout_s + 10)
             if "spillback" in grant:
                 raylet_addr = tuple(grant["spillback"])
@@ -788,6 +799,7 @@ class CoreClient:
             "timeout": self.config.lease_timeout_s,
             "retriable": spec.max_retries > 0,
             "no_spill": True,
+            **env_fields,
         }, timeout=self.config.lease_timeout_s + 10)
         if "error" in grant:
             raise RuntimeError(f"lease failed: {grant['error']}")
@@ -925,7 +937,9 @@ class CoreClient:
                 (k, v if isinstance(v, (str, int, float, bytes, bool,
                                         type(None))) else repr(v))
                 for k, v in strat.items()))
-        return (tuple(sorted(spec.resources.items())), strat)
+        env = _env_lease_fields(spec)
+        return (tuple(sorted(spec.resources.items())), strat,
+                env.get("runtime_env_key", ""))
 
     def _ensure_lanes(self, key: tuple) -> None:
         """Spawn lanes so every queued task can run CONCURRENTLY (up to the
@@ -1274,6 +1288,7 @@ class CoreClient:
             grant = await raylet.call("request_lease", {
                 "resources": spec.resources, "strategy": "LOCAL",
                 "timeout": self.config.lease_timeout_s,
+                **_env_lease_fields(spec),
             }, timeout=self.config.lease_timeout_s + 10)
             if "error" in grant or "spillback" in grant:
                 raise RuntimeError(f"actor placement failed: {grant}")

@@ -45,6 +45,7 @@ class WorkerHandle:
     bundle_key: tuple | None = None          # (pg_id, index) when PG-backed
     started: float = field(default_factory=time.monotonic)
     leased_at: float = 0.0                   # when the current lease was granted
+    env_key: str = ""                        # pip-env digest ("" = base image)
     proc: Any = None
 
 
@@ -55,6 +56,8 @@ class LeaseRequest:
     future: asyncio.Future
     bundle_key: tuple | None = None          # grant from this PG bundle
     retriable: bool = True                   # OOM-kill preference hint
+    env_key: str = ""                        # pip-env digest
+    pip_env: dict | None = None              # build recipe for env_key
     enqueued: float = field(default_factory=time.monotonic)
 
 
@@ -92,6 +95,7 @@ class Raylet:
         # never another connection's zombie (freed+re-created) extent.
         self._conn_pins: dict[int, dict] = {}
         self.lease_queue: list[LeaseRequest] = []
+        self._env_spawning: set[str] = set()   # pip envs being built
         # (pg_id, bundle_index) → {"total": res, "free": res}. Reserved out
         # of resources_available via the GCS 2PC (ref: node_manager.proto:
         # 377-384 PrepareBundle/CommitBundle).
@@ -266,12 +270,22 @@ class Raylet:
 
     # ------------------------------------------------------- worker pool
 
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, env_key: str = "",
+                      python: str | None = None) -> WorkerHandle:
         worker_id = WorkerID.from_random().binary()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = WorkerID(worker_id).hex()
+        if python is not None:
+            # Venv interpreter (pip runtime env): ray_tpu itself isn't
+            # installed into the venv — make it importable from the repo.
+            import ray_tpu as _pkg
+
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(_pkg.__file__)))
+            env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+                "PYTHONPATH", "")
         cmd = [
-            sys.executable, "-m", "ray_tpu.core.worker",
+            python or sys.executable, "-m", "ray_tpu.core.worker",
             "--raylet", f"{self.address[0]}:{self.address[1]}",
             "--gcs", f"{self.gcs_address[0]}:{self.gcs_address[1]}",
             "--node-id", NodeID(self.node_id).hex(),
@@ -282,9 +296,48 @@ class Raylet:
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{WorkerID(worker_id).hex()[:8]}.log"), "ab")
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
-        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc, idle=False)
+        handle = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc,
+                              idle=False, env_key=env_key)
         self.workers[worker_id] = handle
         return handle
+
+    def _spawn_env_worker(self, env_key: str, pip_env: dict) -> None:
+        """Build the pip venv off-loop, then spawn a worker on its
+        interpreter. At most one build+spawn in flight per env key — the
+        registered worker pumps the lease queue."""
+        if env_key in self._env_spawning:
+            return
+        self._env_spawning.add(env_key)
+
+        async def build_and_spawn():
+            from ray_tpu.core.runtime_env import ensure_pip_env
+
+            try:
+                loop = asyncio.get_running_loop()
+
+                def kv_get(ns, key):
+                    fut = asyncio.run_coroutine_threadsafe(
+                        self.gcs.call("kv_get", {"ns": ns, "key": key},
+                                      timeout=120),
+                        loop)
+                    return fut.result(180)
+
+                python = await asyncio.to_thread(
+                    ensure_pip_env, pip_env, self.session_dir, kv_get)
+                self._spawn_worker(env_key=env_key, python=python)
+            except Exception as e:
+                logger.error("pip env %s build failed: %s", env_key, e)
+                # Fail every queued lease waiting on this env — they would
+                # otherwise hang until lease timeout.
+                for req in list(self.lease_queue):
+                    if req.env_key == env_key and not req.future.done():
+                        req.future.set_result(
+                            {"error": f"runtime_env build failed: {e}"})
+                        self.lease_queue.remove(req)
+            finally:
+                self._env_spawning.discard(env_key)
+
+        asyncio.ensure_future(build_and_spawn())
 
     async def _h_register_worker(self, conn, p):
         worker_id = p["worker_id"]
@@ -615,6 +668,8 @@ class Raylet:
         req = LeaseRequest(
             resources=resources, strategy=strategy,
             retriable=p.get("retriable", True),
+            env_key=p.get("runtime_env_key", ""),
+            pip_env=p.get("pip_env"),
             future=asyncio.get_running_loop().create_future(),
         )
         self.lease_queue.append(req)
@@ -668,6 +723,8 @@ class Raylet:
         req = LeaseRequest(
             resources=resources, strategy=strategy, bundle_key=key,
             retriable=p.get("retriable", True),
+            env_key=p.get("runtime_env_key", ""),
+            pip_env=p.get("pip_env"),
             future=asyncio.get_running_loop().create_future(),
         )
         self.lease_queue.append(req)
@@ -691,7 +748,7 @@ class Raylet:
                     continue
             elif not self._available(req.resources):
                 continue
-            worker = self._find_idle_worker()
+            worker = self._find_idle_worker(req.env_key)
             if worker is None:
                 # Spawn only up to the node's concurrency capacity: one slot
                 # per whole CPU plus actor-pinned workers (ref: worker_pool.cc
@@ -704,7 +761,10 @@ class Raylet:
                     self.config.max_workers_per_node,
                 )
                 if len(self.workers) < cap:
-                    self._spawn_worker()
+                    if req.env_key:
+                        self._spawn_env_worker(req.env_key, req.pip_env or {})
+                    else:
+                        self._spawn_worker()
                 continue
             worker.idle = False
             worker.lease_resources = dict(req.resources)
@@ -729,9 +789,13 @@ class Raylet:
             if req in self.lease_queue:
                 self.lease_queue.remove(req)
 
-    def _find_idle_worker(self) -> WorkerHandle | None:
+    def _find_idle_worker(self, env_key: str = "") -> WorkerHandle | None:
+        # Strict env matching: a pip-env worker's interpreter has extra
+        # packages — base-image tasks never run there, and vice versa
+        # (ref: worker_pool.cc pools keyed by runtime env).
         for h in self.workers.values():
-            if h.idle and h.conn is not None and h.actor_id is None:
+            if (h.idle and h.conn is not None and h.actor_id is None
+                    and h.env_key == env_key):
                 return h
         return None
 

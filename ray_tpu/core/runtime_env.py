@@ -1,11 +1,16 @@
-"""Runtime environments: per-task/actor env vars + working_dir shipping.
+"""Runtime environments: env vars, working_dir shipping, pip venv isolation.
 
-Parity: `/root/reference/python/ray/_private/runtime_env/` — the two
-plugins that matter for a single-image TPU fleet: `env_vars` (applied in
-the worker before user code runs) and `working_dir` (directory zipped by
-the submitter, content-addressed in the GCS KV as the reference does with
-its package URIs (`runtime_env/packaging.py`), extracted + sys.path'd on
-the executing node, cached by digest). Conda/container plugins are a
+Parity: `/root/reference/python/ray/_private/runtime_env/` — `env_vars`
+(applied in the worker before user code runs), `working_dir` (directory
+zipped by the submitter, content-addressed in the GCS KV as the reference
+does with its package URIs (`runtime_env/packaging.py`), extracted +
+sys.path'd on the executing node, cached by digest), and `pip`
+(`runtime_env/pip.py`): the raylet builds a hashed, cached venv
+(--system-site-packages, so jax & friends come from the base image) and
+spawns the lease's worker with THAT interpreter. Entries may be package
+specs or local wheel paths — wheels are content-addressed into the GCS KV
+and installed with --no-index, which is also the zero-egress path this
+fleet runs in. Venvs are LRU-evicted. Conda/container plugins are a
 deliberate non-goal: TPU hosts run one prebuilt image.
 """
 
@@ -42,8 +47,8 @@ def package_working_dir(path: str) -> tuple[str, bytes]:
 
 
 def resolve_runtime_env(env: dict | None, client) -> dict | None:
-    """Submitter side: upload working_dir once (content-addressed KV),
-    rewrite the env to reference the URI."""
+    """Submitter side: upload working_dir / local wheels once
+    (content-addressed KV), rewrite the env to reference URIs."""
     if not env:
         return env
     out = dict(env)
@@ -54,7 +59,143 @@ def resolve_runtime_env(env: dict | None, client) -> dict | None:
         if client.kv_get("runtime_env", key) is None:
             client.kv_put("runtime_env", key, data)
         out["working_dir_uri"] = digest
+    pip = out.pop("pip", None)
+    if pip:
+        if isinstance(pip, str):
+            pip = [pip]
+        specs: list[str] = []
+        wheels: dict[str, str] = {}     # basename → content digest
+        for item in pip:
+            if (item.endswith((".whl", ".tar.gz"))
+                    and os.path.exists(item)):
+                data = open(item, "rb").read()
+                wdig = hashlib.sha256(data).hexdigest()[:32]
+                key = f"whl:{wdig}".encode()
+                if client.kv_get("runtime_env", key) is None:
+                    client.kv_put("runtime_env", key, data)
+                wheels[os.path.basename(item)] = wdig
+            else:
+                specs.append(item)
+        env_digest = hashlib.sha256(repr(
+            (sorted(specs), sorted(wheels.items()))
+        ).encode()).hexdigest()[:32]
+        out["pip_env"] = {"digest": env_digest, "specs": sorted(specs),
+                          "wheels": wheels}
     return out
+
+
+# ------------------------------------------------------------- pip venvs
+
+PIP_CACHE_SIZE = int(os.environ.get("RAY_TPU_PIP_ENV_CACHE", "8"))
+
+
+def pip_env_python(session_dir: str, digest: str) -> str:
+    return os.path.join(session_dir, "runtime_envs", "pip", digest,
+                        "venv", "bin", "python")
+
+
+def ensure_pip_env(pip_env: dict, session_dir: str, kv_get) -> str:
+    """Raylet side: build (or reuse) the venv for `pip_env`; returns its
+    python executable. kv_get(ns, key) fetches uploaded wheels.
+
+    Layout: <session>/runtime_envs/pip/<digest>/{venv/, wheels/, .ready,
+    .last_used}. Build is atomic via the .ready marker; concurrent callers
+    race benignly (same content). LRU beyond PIP_CACHE_SIZE evicts the
+    least-recently-used ready env.
+    """
+    import shutil
+    import subprocess
+    import time
+
+    base = os.path.join(session_dir, "runtime_envs", "pip")
+    root = os.path.join(base, pip_env["digest"])
+    ready = os.path.join(root, ".ready")
+    py = pip_env_python(session_dir, pip_env["digest"])
+    if os.path.exists(ready):
+        _touch(os.path.join(root, ".last_used"))
+        return py
+    os.makedirs(root, exist_ok=True)
+    venv_dir = os.path.join(root, "venv")
+    wheel_dir = os.path.join(root, "wheels")
+    os.makedirs(wheel_dir, exist_ok=True)
+    for fname, wdig in pip_env.get("wheels", {}).items():
+        data = kv_get("runtime_env", f"whl:{wdig}".encode())
+        if data is None:
+            raise RuntimeError(f"wheel {fname} ({wdig}) not in GCS KV")
+        with open(os.path.join(wheel_dir, fname), "wb") as f:
+            f.write(data)
+    # --system-site-packages: the heavyweight base stack (jax, numpy, …)
+    # comes from the image; the venv only layers the requested packages.
+    subprocess.run(
+        [sys.executable, "-m", "venv", "--system-site-packages", venv_dir],
+        check=True, capture_output=True)
+    # If the BASE interpreter is itself a venv (common: /opt/venv images),
+    # --system-site-packages links the system python's site dir, not the
+    # base venv's. A .pth appends the parent's site-packages — after the
+    # new venv's own, so requested packages still shadow the base.
+    import glob as _glob
+
+    parent_sites = [p for p in sys.path if p.endswith("site-packages")
+                    and os.path.isdir(p)]
+    for venv_site in _glob.glob(
+            os.path.join(venv_dir, "lib", "python*", "site-packages")):
+        with open(os.path.join(venv_site, "_parent_sites.pth"), "w") as f:
+            f.write("\n".join(parent_sites) + "\n")
+    targets = list(pip_env.get("specs", ()))
+    wheel_files = [os.path.join(wheel_dir, f)
+                   for f in sorted(pip_env.get("wheels", {}))]
+    if wheel_files or targets:
+        cmd = [py, "-m", "pip", "install", "--quiet",
+               "--disable-pip-version-check"]
+        if wheel_files and not targets:
+            # Pure-local install: never touch an index (zero-egress path).
+            cmd += ["--no-index"] + wheel_files
+        else:
+            cmd += ["--find-links", wheel_dir] + wheel_files + targets
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            shutil.rmtree(root, ignore_errors=True)
+            raise RuntimeError(
+                f"pip env build failed: {r.stderr[-800:]}")
+    _touch(ready)
+    _touch(os.path.join(root, ".last_used"))
+    _evict_lru(base)
+    return py
+
+
+def _touch(path: str) -> None:
+    with open(path, "a"):
+        os.utime(path, None)
+
+
+_EVICT_MIN_AGE_S = 3600.0
+
+
+def _evict_lru(base: str) -> None:
+    """Evict least-recently-used envs beyond the cache cap — but never one
+    used within the last hour: a worker spawned on that interpreter may
+    still be alive (the raylet's idle-worker TTL reaps it well within the
+    age floor, so deleting only old envs can't pull the venv out from
+    under a live process)."""
+    import time
+
+    try:
+        envs = [
+            (os.path.getmtime(os.path.join(base, d, ".last_used")), d)
+            for d in os.listdir(base)
+            if os.path.exists(os.path.join(base, d, ".ready"))
+        ]
+    except OSError:
+        return
+    if len(envs) <= PIP_CACHE_SIZE:
+        return
+    import shutil
+
+    now = time.time()
+    for mtime, d in sorted(envs)[: len(envs) - PIP_CACHE_SIZE]:
+        if now - mtime < _EVICT_MIN_AGE_S:
+            continue
+        shutil.rmtree(os.path.join(base, d), ignore_errors=True)
 
 
 _applied_dirs: dict[str, str] = {}

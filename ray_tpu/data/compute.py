@@ -1,0 +1,117 @@
+"""Compute strategies for Dataset map stages.
+
+Parity: `/root/reference/python/ray/data/_internal/compute.py:88`
+(ActorPoolStrategy) — stateful block transforms run on a pool of reusable
+actors instead of stateless tasks, so per-actor state (model weights, a
+jitted apply) is built ONCE per actor and amortized over many blocks. The
+pool autoscales between min_size and max_size based on in-flight depth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import ray_tpu
+
+
+@dataclass(frozen=True)
+class ActorPoolStrategy:
+    """map_batches(fn, compute=ActorPoolStrategy(2, 8)).
+
+    min_size actors start immediately; when every actor already has
+    max_tasks_in_flight blocks queued and more remain, the pool grows (up
+    to max_size). `fn` may be a class: it is constructed once per actor.
+    """
+
+    min_size: int = 1
+    max_size: int | None = None
+    max_tasks_in_flight: int = 2
+    resources: dict | None = None
+
+    def __post_init__(self):
+        if self.min_size < 1:
+            raise ValueError("min_size must be >= 1")
+        if self.max_size is not None and self.max_size < self.min_size:
+            raise ValueError("max_size < min_size")
+
+
+class _BlockMapActor:
+    """Hosts one constructed transform; applies it to blocks serially."""
+
+    def __init__(self, ctor_packed: bytes):
+        from ray_tpu.core import serialization
+
+        make_apply = serialization.unpack(ctor_packed)
+        self._apply = make_apply()
+
+    def apply(self, blk):
+        return self._apply(blk)
+
+    def ping(self) -> bool:
+        return True
+
+
+def run_actor_map(ctor_packed: bytes, refs: list,
+                  strat: ActorPoolStrategy) -> list:
+    """Map every block ref through an autoscaling actor pool.
+
+    Returns result refs in block order. The pool is torn down after all
+    blocks complete (this stage is a barrier, unlike task-compute stages —
+    same as the reference, where actor-pool stages break fusion).
+    """
+    if not refs:
+        return []
+    max_size = strat.max_size or max(strat.min_size, len(refs))
+
+    def spawn():
+        opts = {}
+        if strat.resources:
+            opts["resources"] = dict(strat.resources)
+        cls = ray_tpu.remote(_BlockMapActor)
+        if opts:
+            cls = cls.options(**opts)
+        return cls.remote(ctor_packed)
+
+    actors = [spawn() for _ in range(strat.min_size)]
+    counts = [0] * len(actors)
+    results: list = [None] * len(refs)
+    owner: dict[bytes, int] = {}   # result ref id → actor index
+
+    def drain(block: bool) -> None:
+        outstanding = [r for r in results if r is not None
+                       and r.id.binary() in owner]
+        if not outstanding:
+            return
+        ready, _ = ray_tpu.wait(
+            outstanding, num_returns=1 if block else len(outstanding),
+            timeout=None if block else 0)
+        for r in ready:
+            j = owner.pop(r.id.binary(), None)
+            if j is not None:
+                counts[j] -= 1
+
+    for i, blk_ref in enumerate(refs):
+        drain(block=False)
+        j = min(range(len(actors)), key=lambda k: counts[k])
+        if counts[j] >= strat.max_tasks_in_flight and len(actors) < max_size:
+            actors.append(spawn())
+            counts.append(0)
+            j = len(actors) - 1
+        while counts[j] >= strat.max_tasks_in_flight:
+            drain(block=True)
+            j = min(range(len(actors)), key=lambda k: counts[k])
+        out = actors[j].apply.remote(blk_ref)
+        results[i] = out
+        owner[out.id.binary()] = j
+        counts[j] += 1
+
+    # Barrier: actors must outlive their queued work.
+    if results:
+        ray_tpu.wait(results, num_returns=len(results), timeout=None)
+    for a in actors:
+        try:
+            ray_tpu.kill(a)
+        except Exception:
+            pass
+    return results

@@ -42,6 +42,19 @@ class AllToAllStage:
     fn: Callable[[list], list]
 
 
+@dataclass
+class ActorMapStage:
+    """block → block on a reusable actor pool (stateful transforms).
+
+    Not fused with task MapStages — the pool is a barrier (ref:
+    data/_internal/compute.py ActorPoolStrategy semantics).
+    """
+
+    name: str
+    ctor_packed: bytes          # unpack() -> make_apply() -> block→block fn
+    compute: Any                # ActorPoolStrategy
+
+
 def _fused_map(fns: list[Callable[[Any], Any]]):
     def apply(blk):
         for f in fns:
@@ -95,6 +108,11 @@ class Dataset:
                     i += 1
                 packed = serialization.pack(_fused_map(fns))
                 refs = [_map_block_task.remote(packed, r) for r in refs]
+            elif isinstance(stage, ActorMapStage):
+                from ray_tpu.data.compute import run_actor_map
+
+                refs = run_actor_map(stage.ctor_packed, refs, stage.compute)
+                i += 1
             else:
                 refs = stage.fn(refs)
                 i += 1
@@ -111,20 +129,44 @@ class Dataset:
         *,
         batch_format: str = "numpy",
         batch_size: int | None = None,
+        compute: Any = None,
     ) -> "Dataset":
-        def apply(blk):
-            n = B.num_rows(blk)
-            if n == 0:
-                return blk
-            size = batch_size or n
-            outs = []
-            for s in range(0, n, size):
-                batch = B.to_batch(B.slice_block(blk, s, min(s + size, n)),
-                                   batch_format)
-                outs.append(B.from_batch(fn(batch)))
-            return B.concat_blocks(outs)
+        """Transform batches. `fn` is a function, or — with
+        `compute=ActorPoolStrategy(...)` — a callable CLASS constructed once
+        per pool actor, so expensive state (model weights, a jitted apply)
+        loads per actor, not per block (ref: dataset.py:325 +
+        _internal/compute.py:88)."""
 
-        return self._with_stage(MapStage("map_batches", apply))
+        def make_apply():
+            user = fn() if isinstance(fn, type) else fn
+
+            def apply(blk):
+                n = B.num_rows(blk)
+                if n == 0:
+                    return blk
+                size = batch_size or n
+                outs = []
+                for s in range(0, n, size):
+                    batch = B.to_batch(
+                        B.slice_block(blk, s, min(s + size, n)), batch_format)
+                    outs.append(B.from_batch(user(batch)))
+                return B.concat_blocks(outs)
+
+            return apply
+
+        if compute is not None:
+            from ray_tpu.core import serialization
+            from ray_tpu.data.compute import ActorPoolStrategy
+
+            if not isinstance(compute, ActorPoolStrategy):
+                raise TypeError(
+                    f"compute must be an ActorPoolStrategy, got {compute!r}")
+            return self._with_stage(ActorMapStage(
+                "map_batches", serialization.pack(make_apply), compute))
+        if isinstance(fn, type):
+            raise ValueError(
+                "a callable class requires compute=ActorPoolStrategy(...)")
+        return self._with_stage(MapStage("map_batches", make_apply()))
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         def apply(blk):

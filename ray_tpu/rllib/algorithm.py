@@ -31,6 +31,10 @@ class AlgorithmConfig:
         self.lr = 5e-4
         self.train_batch_size = 512
         self.model_hiddens = (64, 64)
+        # Model catalog selector: None = MLP on flattened obs; "nature" =
+        # shared Nature-CNN torso for [H,W,C] pixel observations
+        # (rllib/models.py — ref: rllib/models/catalog.py vision nets).
+        self.model_conv: str | None = None
 
     def environment(self, env, *, seed: int = 0) -> "AlgorithmConfig":
         self.env = env
@@ -76,6 +80,7 @@ class Algorithm:
             num_envs_per_worker=config.num_envs_per_worker,
             rollout_fragment_length=config.rollout_fragment_length,
             hiddens=tuple(config.model_hiddens),
+            conv=config.model_conv,
             seed=config.env_seed,
         )
         self._timesteps_total = 0

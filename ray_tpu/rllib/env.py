@@ -152,7 +152,90 @@ class Pendulum(VectorEnv):
         ).astype(np.float32)
 
 
-_ENVS = {"CartPole-v1": CartPole, "Pendulum-v1": Pendulum}
+class PixelCatch(VectorEnv):
+    """Synthetic Atari-class pixel env with the standard preprocessing
+    contract: uint8 grayscale frames, frame-stacked along the channel axis
+    ([H, W, 4] like DeepMind-style Atari wrappers — ref:
+    `/root/reference/rllib/env/wrappers/atari_wrappers.py` FrameStack/
+    WarpFrame). Game: a ball falls from the top in a random column; a
+    3-cell paddle at the bottom moves left/stay/right. +1 caught, -1
+    missed, episode ends when the ball reaches the bottom row. Optimal
+    policy must LOOK at the pixels — the ball column is only in the frame.
+
+    The default (size=21, scale=4) renders 84x84x4 — exactly the Atari
+    shape BASELINE config 4 trains on.
+    """
+
+    SIZE = 21
+    SCALE = 4
+    STACK = 4
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        super().__init__(num_envs, seed)
+        H = self.SIZE * self.SCALE
+        self.observation_space = Space(
+            (H, H, self.STACK), np.uint8)
+        self.action_space = Space((), np.int64, n=3)
+        self.ball_row = np.zeros(num_envs, np.int64)
+        self.ball_col = np.zeros(num_envs, np.int64)
+        self.paddle = np.zeros(num_envs, np.int64)
+        self.frames = np.zeros((num_envs, H, H, self.STACK), np.uint8)
+        self.reset()
+
+    max_steps = 25  # ball lands at t=SIZE-1; margin for truncation path
+
+    def _render(self, idx) -> None:
+        """Draw the current frame for envs `idx`, pushing the stack."""
+        s, S = self.SCALE, self.SIZE
+        self.frames[idx] = np.roll(self.frames[idx], shift=-1, axis=-1)
+        for i in np.atleast_1d(idx):
+            f = np.zeros((S, S), np.uint8)
+            f[self.ball_row[i], self.ball_col[i]] = 255
+            lo = max(0, self.paddle[i] - 1)
+            hi = min(S, self.paddle[i] + 2)
+            f[S - 1, lo:hi] = 128
+            self.frames[i, :, :, -1] = np.repeat(
+                np.repeat(f, s, axis=0), s, axis=1)
+
+    def _reset_idx(self, idx):
+        idx = np.atleast_1d(idx)
+        self.ball_row[idx] = 0
+        self.ball_col[idx] = self.rng.integers(0, self.SIZE, len(idx))
+        self.paddle[idx] = self.SIZE // 2
+        # Fresh episode: the whole stack shows the first frame.
+        self.frames[idx] = 0
+        for _ in range(self.STACK):
+            self._render(idx)
+
+    def _step(self, actions):
+        move = np.asarray(actions, np.int64) - 1          # {-1, 0, +1}
+        self.paddle = np.clip(self.paddle + move, 0, self.SIZE - 1)
+        self.ball_row = self.ball_row + 1
+        done = self.ball_row >= self.SIZE - 1
+        caught = np.abs(self.ball_col - self.paddle) <= 1
+        reward = np.where(
+            done, np.where(caught, 1.0, -1.0), 0.0).astype(np.float32)
+        self.ball_row = np.minimum(self.ball_row, self.SIZE - 1)
+        self._render(np.arange(self.num_envs))
+        return reward, done
+
+    def _obs(self):
+        return self.frames.copy()
+
+
+class PixelCatchSmall(PixelCatch):
+    """42x42x4 variant for fast CI (the Nature CNN's receptive field needs
+    at least ~36px; scale=2 keeps compile+step cheap)."""
+
+    SCALE = 2
+
+
+_ENVS = {
+    "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
+    "PixelCatch-v0": PixelCatch,
+    "PixelCatchSmall-v0": PixelCatchSmall,
+}
 
 
 def register_env(name: str, cls) -> None:

@@ -1,0 +1,69 @@
+"""Model catalog: shared feature torsos for policies.
+
+Parity: `/root/reference/rllib/models/catalog.py` — the catalog picks a
+torso by observation shape/config; here the two entries that matter are
+the default MLP (policy.py) and the Nature-CNN conv stack used by every
+Atari-class pixel policy (conv 32x8s4 → 64x4s2 → 64x3s1 → dense 512,
+the architecture of the reference's vision networks). Pure functional
+JAX: init returns a pytree, apply is jit-safe, convs map onto the MXU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# (out_channels, kernel, stride) per conv layer + trailing dense width.
+NATURE_CNN = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+NATURE_DENSE = 512
+
+
+def init_conv_torso(key, obs_shape: tuple, *, spec=NATURE_CNN,
+                    dense: int = NATURE_DENSE) -> dict:
+    """obs_shape: (H, W, C) pixels. Returns torso params; feature dim is
+    `dense`."""
+    H, W, C = obs_shape
+    params: dict = {"convs": [], "dense": None}
+    in_c = C
+    h, w = H, W
+    for out_c, k, s in spec:
+        key, sub = jax.random.split(key)
+        fan_in = k * k * in_c
+        params["convs"].append({
+            "w": jax.random.normal(
+                sub, (k, k, in_c, out_c), jnp.float32
+            ) * np.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((out_c,), jnp.float32),
+        })
+        # VALID conv output size
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+        in_c = out_c
+        if h < 1 or w < 1:
+            raise ValueError(
+                f"obs {obs_shape} too small for conv spec {spec}")
+    flat = h * w * in_c
+    key, sub = jax.random.split(key)
+    params["dense"] = {
+        "w": jax.random.normal(
+            sub, (flat, dense), jnp.float32) * np.sqrt(2.0 / flat),
+        "b": jnp.zeros((dense,), jnp.float32),
+    }
+    return params
+
+
+def apply_conv_torso(params: dict, obs: jax.Array, *,
+                     spec=NATURE_CNN) -> jax.Array:
+    """obs: [B, H, W, C] float (already normalized) → features [B, dense]."""
+    x = obs
+    for layer, (_, _, s) in zip(params["convs"], spec):
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"], window_strides=(s, s), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + layer["b"]
+        x = jax.nn.relu(x)
+    x = x.reshape(x.shape[0], -1)
+    x = x @ params["dense"]["w"] + params["dense"]["b"]
+    return jax.nn.relu(x)

@@ -42,30 +42,64 @@ def _mlp(params, x):
 
 class Policy:
     """Actor-critic with categorical (discrete) or diagonal-gaussian
-    (continuous) action head and a separate value MLP."""
+    (continuous) action head and a separate value MLP.
+
+    With `conv="nature"` (model catalog, rllib/models.py) a shared
+    Nature-CNN torso feeds both heads — the Atari-class pixel policy. Pixel
+    observations (uint8 [H,W,C]) are normalized to [0,1] inside the jitted
+    paths, so rollout workers ship compact uint8 batches.
+    """
 
     def __init__(self, obs_space: Space, action_space: Space,
-                 hiddens=(64, 64), seed: int = 0):
+                 hiddens=(64, 64), seed: int = 0, conv: str | None = None):
         self.obs_space = obs_space
         self.action_space = action_space
         self.discrete = action_space.discrete
-        obs_dim = int(np.prod(obs_space.shape))
+        self.conv = conv
         act_dim = action_space.n if self.discrete else int(
             np.prod(action_space.shape))
         key = jax.random.key(seed)
-        kp, kv = jax.random.split(key)
-        self.params = {
-            "pi": _init_mlp(kp, (obs_dim, *hiddens, act_dim)),
-            "vf": _init_mlp(kv, (obs_dim, *hiddens, 1), scale_last=1.0),
-        }
+        kp, kv, kt = jax.random.split(key, 3)
+        if conv is not None:
+            from ray_tpu.rllib.models import NATURE_DENSE, init_conv_torso
+
+            if len(obs_space.shape) != 3:
+                raise ValueError(
+                    f"conv policy needs [H,W,C] obs, got {obs_space.shape}")
+            self.params = {
+                "torso": init_conv_torso(kt, obs_space.shape),
+                "pi": _init_mlp(kp, (NATURE_DENSE, act_dim)),
+                "vf": _init_mlp(kv, (NATURE_DENSE, 1), scale_last=1.0),
+            }
+        else:
+            obs_dim = int(np.prod(obs_space.shape))
+            self.params = {
+                "pi": _init_mlp(kp, (obs_dim, *hiddens, act_dim)),
+                "vf": _init_mlp(kv, (obs_dim, *hiddens, 1), scale_last=1.0),
+            }
         if not self.discrete:
             self.params["log_std"] = jnp.zeros((act_dim,), jnp.float32)
         self._sample = jax.jit(self._sample_impl)
 
+    # ---- features ----
+
+    def _features(self, params, obs):
+        """→ (pi input, vf input). Conv: one shared torso pass."""
+        if self.conv is not None:
+            from ray_tpu.rllib.models import apply_conv_torso
+
+            x = obs.astype(jnp.float32)
+            if self.obs_space.dtype == np.uint8:
+                x = x / 255.0
+            feats = apply_conv_torso(params["torso"], x)
+            return feats, feats
+        return obs, obs
+
     # ---- distributions ----
 
     def _dist(self, params, obs):
-        logits = _mlp(params["pi"], obs)
+        pi_in, _ = self._features(params, obs)
+        logits = _mlp(params["pi"], pi_in)
         if self.discrete:
             return logits, None
         return logits, jnp.exp(params["log_std"])
@@ -89,7 +123,10 @@ class Policy:
         return jnp.sum(jnp.log(std) + 0.5 * jnp.log(2 * jnp.pi * jnp.e))
 
     def value(self, params, obs):
-        return _mlp(params["vf"], obs)[:, 0]
+        # Duplicate torso passes inside one jitted loss are CSE'd by XLA
+        # (same params + obs), so _logp/_entropy/value stay independent.
+        _, vf_in = self._features(params, obs)
+        return _mlp(params["vf"], vf_in)[:, 0]
 
     def _sample_impl(self, params, obs, key):
         mean_or_logits, std = self._dist(params, obs)

@@ -26,7 +26,8 @@ class RolloutWorker:
     policy weights. Runs as an actor (remote) or in-process (local mode)."""
 
     def __init__(self, env: Any, *, num_envs: int = 1, seed: int = 0,
-                 hiddens=(64, 64), rollout_fragment_length: int = 64,
+                 hiddens=(64, 64), conv: str | None = None,
+                 rollout_fragment_length: int = 64,
                  jax_platform: str | None = None):
         # Remote samplers run their small policy MLP on host CPU: per-step
         # inference on tiny batches would be dominated by TPU dispatch
@@ -37,7 +38,7 @@ class RolloutWorker:
         self.env = make_env(env, num_envs=num_envs, seed=seed)
         self.policy = Policy(
             self.env.observation_space, self.env.action_space,
-            hiddens=hiddens, seed=seed,
+            hiddens=hiddens, conv=conv, seed=seed,
         )
         self.fragment = rollout_fragment_length
         self.key = jax.random.key(seed)
@@ -52,8 +53,10 @@ class RolloutWorker:
         """One [T, N] fragment. Also records completed-episode returns."""
         T, N = self.fragment, self.env.num_envs
         cols = {
+            # Keep the env's obs dtype: pixel envs hand out uint8 frames
+            # (4x smaller batches); the policy normalizes on device.
             sb.OBS: np.zeros((T, N) + self.env.observation_space.shape,
-                             np.float32),
+                             self.env.observation_space.dtype),
             sb.ACTIONS: None,
             sb.REWARDS: np.zeros((T, N), np.float32),
             sb.DONES: np.zeros((T, N), bool),
@@ -109,10 +112,10 @@ class WorkerSet:
 
     def __init__(self, env, *, num_workers: int = 0, num_envs_per_worker: int = 1,
                  rollout_fragment_length: int = 64, hiddens=(64, 64),
-                 seed: int = 0):
+                 conv: str | None = None, seed: int = 0):
         self.local = RolloutWorker(
             env, num_envs=num_envs_per_worker, seed=seed, hiddens=hiddens,
-            rollout_fragment_length=rollout_fragment_length,
+            conv=conv, rollout_fragment_length=rollout_fragment_length,
         )
         self.remote_workers = []
         if num_workers > 0:
@@ -120,7 +123,7 @@ class WorkerSet:
             self.remote_workers = [
                 actor_cls.remote(
                     env, num_envs=num_envs_per_worker, seed=seed + 1 + i,
-                    hiddens=hiddens,
+                    hiddens=hiddens, conv=conv,
                     rollout_fragment_length=rollout_fragment_length,
                     jax_platform="cpu",
                 )

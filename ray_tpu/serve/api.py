@@ -226,6 +226,48 @@ class DeploymentHandle:
         replica = self._pick_replica()
         return replica.handle_request.remote(method_name, args, kwargs)
 
+    def stream(self, request: dict, *,
+               submit_method: str = "submit_stream",
+               poll_method: str = "stream_read",
+               poll_timeout_s: float = 0.25,
+               deadline_s: float = 600.0):
+        """Incremental results from a streaming deployment (e.g. the LLM
+        engine's per-token stream): yields items as the replica produces
+        them instead of buffering the full response. The whole stream is
+        pinned to ONE replica — the cursor state lives there. Protocol:
+        `submit_method(request) -> stream_id`, then
+        `poll_method(stream_id, cursor, timeout) ->
+        {"tokens": [...], "done": bool, ...}` long-polled until done.
+        """
+        import ray_tpu
+
+        replica = self._pick_replica()
+        sid = ray_tpu.get(
+            replica.handle_request.remote(submit_method, (request,), {}),
+            timeout=deadline_s)
+
+        def gen():
+            import time as _time
+
+            cursor = 0
+            t_end = _time.monotonic() + deadline_s
+            while True:
+                out = ray_tpu.get(
+                    replica.handle_request.remote(
+                        poll_method, (sid, cursor, poll_timeout_s), {}),
+                    timeout=60)
+                for tok in out["tokens"]:
+                    yield tok
+                cursor += len(out["tokens"])
+                if out.get("error"):
+                    raise RuntimeError(out["error"])
+                if out.get("done"):
+                    return
+                if _time.monotonic() > t_end:
+                    raise TimeoutError(f"stream {sid} exceeded deadline")
+
+        return gen()
+
     def __reduce__(self):
         # Handles travel into replica constructors (deployment graphs);
         # routing state (locks, caches) rebuilds in the destination process.

@@ -68,6 +68,13 @@ class Replica:
                 continue
             strikes = strikes + 1 if not ok else 0
             if strikes >= 2:
+                # Drain before exiting: a saturated-but-healthy replica can
+                # be dropped by a timed-out health probe — its in-flight
+                # requests must complete (bounded wait; the routing table
+                # already stopped sending new work here).
+                deadline = time.monotonic() + 120
+                while self._inflight > 0 and time.monotonic() < deadline:
+                    time.sleep(0.5)
                 os._exit(0)
 
     def health(self) -> bool:

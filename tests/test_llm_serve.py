@@ -212,3 +212,81 @@ class TestServeIntegration:
         finally:
             serve.shutdown()
             ray_tpu.shutdown()
+
+    def test_streaming_handle_and_http_sse(self):
+        """VERDICT r2 item 2: clients see tokens BEFORE generation
+        completes — via DeploymentHandle.stream and via the HTTP proxy's
+        SSE path (first data event must arrive well before [DONE])."""
+        import json
+        import socket
+
+        import ray_tpu
+        from ray_tpu import serve
+
+        ray_tpu.init(num_cpus=4)
+        try:
+            from ray_tpu.serve.llm import LLMDeployment
+
+            dep = serve.deployment(LLMDeployment, name="llmstream").options(
+                num_replicas=1, route_prefix="/llm").bind(
+                "tiny", n_slots=4, max_len=512, jax_platform="cpu",
+                engine_kwargs={"prefill_buckets": (8, 16)})
+            handle = serve.run(dep)
+
+            # Warm: first generate compiles the prefill bucket + decode
+            # step; timing assertions below must measure streaming, not XLA
+            # compile latency.
+            ray_tpu.get(handle.method(
+                "generate", [5, 9, 2], max_tokens=4), timeout=300)
+
+            # --- handle streaming: tokens arrive incrementally
+            arrivals = []
+            toks = []
+            t0 = time.perf_counter()
+            for tok in handle.stream(
+                    {"prompt_ids": [5, 9, 2], "max_tokens": 120}):
+                arrivals.append(time.perf_counter() - t0)
+                toks.append(tok)
+            assert len(toks) == 120
+            # First token must land in a fraction of total stream time.
+            assert arrivals[0] < arrivals[-1] * 0.5, (
+                f"first token at {arrivals[0]:.3f}s vs last "
+                f"{arrivals[-1]:.3f}s — stream was buffered")
+
+            # --- HTTP SSE through the proxy
+            from ray_tpu.serve.http_proxy import start_proxy
+
+            _proxy, port = start_proxy()
+            time.sleep(1.0)  # route table refresh
+            body = json.dumps({"prompt_ids": [5, 9, 2],
+                               "max_tokens": 120, "stream": True}).encode()
+            req = (b"POST /llm HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Type: application/json\r\n"
+                   b"Content-Length: " + str(len(body)).encode() +
+                   b"\r\n\r\n" + body)
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=120) as s:
+                s.sendall(req)
+                s.settimeout(120)
+                chunks = []           # (t, bytes)
+                buf = b""
+                t0 = time.perf_counter()
+                while b"data: [DONE]" not in buf:
+                    data = s.recv(4096)
+                    if not data:
+                        break
+                    chunks.append((time.perf_counter() - t0, data))
+                    buf += data
+            assert b"data: [DONE]" in buf, buf[-200:]
+            # (split on b"\n\n" would glue the first event to the \r\n\r\n
+            # header terminator — count events directly)
+            n_tokens = buf.count(b'data: {"token"')
+            assert n_tokens == 120, f"got {n_tokens} token events"
+            t_first = next(t for t, d in chunks if b"data: {" in d)
+            t_done = chunks[-1][0]
+            assert t_first < t_done * 0.5, (
+                f"first SSE bytes at {t_first:.3f}s vs done {t_done:.3f}s "
+                "— the proxy buffered the response")
+        finally:
+            serve.shutdown()
+            ray_tpu.shutdown()

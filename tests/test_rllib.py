@@ -237,3 +237,71 @@ class TestA2C:
         # TestDQN's absolute-threshold style).
         assert final is not None and final > 45, final
         algo.stop()
+
+
+class TestPixelPipeline:
+    """Atari-class pipeline (VERDICT r2 item 10): frame-stacked uint8
+    pixel env + Nature-CNN policy + PPO learning on it."""
+
+    def test_pixel_env_contract(self):
+        from ray_tpu.rllib.env import PixelCatch
+
+        env = PixelCatch(num_envs=3, seed=0)
+        obs = env.reset()
+        assert obs.shape == (3, 84, 84, 4) and obs.dtype == np.uint8
+        assert obs.max() == 255  # ball rendered
+        rewards = []
+        for _ in range(25):
+            obs, r, done, trunc = env.step(np.random.randint(0, 3, 3))
+            rewards.extend(r[done].tolist())
+        # Episodes terminate with ±1 exactly when the ball lands.
+        assert rewards and all(v in (1.0, -1.0) for v in rewards)
+        # Frame stack actually carries history: with the ball falling, the
+        # last two stack channels must differ mid-episode.
+        env2 = PixelCatch(num_envs=1, seed=1)
+        o = env2.reset()
+        o, *_ = env2.step(np.array([1]))
+        assert (o[0, :, :, -1] != o[0, :, :, -2]).any()
+
+    def test_conv_policy_shapes_and_learn_step(self, cluster):
+        from ray_tpu.rllib.env import PixelCatchSmall
+
+        cfg = (PPOConfig()
+               .environment("PixelCatchSmall-v0", seed=0)
+               .rollouts(num_envs_per_worker=2, rollout_fragment_length=16)
+               .training(num_sgd_iter=1, sgd_minibatch_size=32,
+                         model_conv="nature"))
+        algo = cfg.build()
+        res = algo.train()
+        assert np.isfinite(res["total_loss"])
+        # conv torso present in the weights
+        assert "torso" in algo.policy.params
+        algo.stop()
+
+    @pytest.mark.slow
+    def test_ppo_learns_pixel_catch(self, cluster):
+        """Reward improves from random (≈ -0.25) to clearly-catching on the
+        pixel env — closing BASELINE config 4's shape (conv policy learning
+        from frame-stacked pixels)."""
+        from ray_tpu.rllib.env import PixelCatchSmall
+
+        cfg = (PPOConfig()
+               .environment("PixelCatchSmall-v0", seed=0)
+               .rollouts(num_envs_per_worker=8, rollout_fragment_length=64)
+               .training(num_sgd_iter=4, sgd_minibatch_size=128,
+                         lr=1e-3, entropy_coeff=0.01, model_conv="nature"))
+        algo = cfg.build()
+        first = None
+        mean = None
+        for it in range(30):
+            res = algo.train()
+            mean = res.get("episode_return_mean")
+            if first is None and mean is not None:
+                first = mean
+            if mean is not None and mean > 0.6:
+                break
+        assert mean is not None and first is not None
+        assert mean > 0.6, (
+            f"PPO did not learn PixelCatch: first={first:.2f} "
+            f"final={mean:.2f}")
+        algo.stop()
