@@ -1,0 +1,172 @@
+"""Scalability-envelope benchmarks on the multi-node (multi-raylet) harness.
+
+Port of the reference's release envelope suite
+(`/root/reference/release/benchmarks/distributed/test_many_tasks.py:107`,
+`test_many_actors.py`, `test_many_pgs.py`, and the 1-GiB-broadcast row of
+`release/benchmarks/README.md:18`) scaled to one machine: N raylets via
+cluster_utils.Cluster stand in for N nodes. Run:
+
+    python bench_envelope.py [--tasks 10000] [--actors 1000] [--pgs 200]
+        [--broadcast-mb 256] [--nodes 8] [--json-out BENCH_ENVELOPE.json]
+
+Prints one JSON object with tasks/sec, actors launched/sec, PGs/sec, and
+broadcast aggregate bandwidth.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def bench_many_tasks(n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    # Warm the worker pool.
+    ray_tpu.get([noop.remote() for _ in range(16)], timeout=120)
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    ray_tpu.get(refs, timeout=1200)
+    dt = time.perf_counter() - t0
+    return {"num_tasks": n, "tasks_per_second": round(n / dt, 1),
+            "wall_s": round(dt, 2)}
+
+
+def bench_many_actors(n: int, wave: int = 50) -> dict:
+    """Concurrent actors. Spawned in waves: every actor is a full worker
+    process, and on a small-core host an unbounded spawn stampede starves
+    registration past the lease timeout (the reference runs this on
+    64-core nodes; waves measure sustainable creation throughput)."""
+    import ray_tpu
+
+    @ray_tpu.remote(resources={"CPU": 0.001})
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = []
+    for start in range(0, n, wave):
+        batch = [A.remote() for _ in range(min(wave, n - start))]
+        ray_tpu.get([a.ping.remote() for a in batch], timeout=2400)
+        actors.extend(batch)
+        print(f"  wave done: {len(actors)}/{n} alive "
+              f"({time.perf_counter()-t0:.0f}s)", flush=True)
+    # All alive simultaneously: one final whole-pool ping round.
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=2400)
+    dt = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    for a in actors:
+        ray_tpu.kill(a)
+    kill_dt = time.perf_counter() - t1
+    return {"num_actors": n, "actors_per_second": round(n / dt, 1),
+            "wall_s": round(dt, 2), "kill_s": round(kill_dt, 2)}
+
+
+def bench_many_pgs(n: int) -> dict:
+    from ray_tpu.core.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    t0 = time.perf_counter()
+    # Creation is synchronous (2PC reserve inside placement_group()).
+    pgs = [placement_group([{"CPU": 0.01}]) for _ in range(n)]
+    dt = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    for pg in pgs:
+        remove_placement_group(pg)
+    rm_dt = time.perf_counter() - t1
+    return {"num_pgs": n, "pgs_per_second": round(n / dt, 1),
+            "wall_s": round(dt, 2), "remove_s": round(rm_dt, 2)}
+
+
+def bench_broadcast(mb: int, n_nodes: int) -> dict:
+    """One hot object fanned out to every node: a task pinned per node
+    ray_tpu.get()s the same ref; measures aggregate delivery bandwidth
+    (the serve-slot fan-out tree vs N pulls on one holder)."""
+    import ray_tpu
+
+    payload = np.random.default_rng(0).integers(
+        0, 255, mb << 20, dtype=np.uint8)
+    ref = ray_tpu.put(payload)
+
+    @ray_tpu.remote(resources={"node_mark": 0.001})
+    def consume(r):
+        return int(r[0]) + len(r)
+
+    t0 = time.perf_counter()
+    outs = ray_tpu.get(
+        [consume.remote(ref) for _ in range(n_nodes)], timeout=1200)
+    dt = time.perf_counter() - t0
+    assert all(o == int(payload[0]) + len(payload) for o in outs)
+    total_mb = mb * n_nodes
+    return {"broadcast_mb": mb, "receivers": n_nodes,
+            "wall_s": round(dt, 2),
+            "aggregate_mb_per_s": round(total_mb / dt, 1)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tasks", type=int, default=10_000)
+    ap.add_argument("--actors", type=int, default=1_000)
+    ap.add_argument("--pgs", type=int, default=200)
+    ap.add_argument("--broadcast-mb", type=int, default=256)
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--json-out", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma list: tasks,actors,pgs,broadcast")
+    args = ap.parse_args()
+
+    from ray_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    # Long lease window: on a small-core host, waves of worker spawns
+    # queue behind each other; 60s would fail placements spuriously.
+    cluster = Cluster(head_node_args={"num_cpus": 4},
+                      _system_config={"lease_timeout_s": 240.0})
+    # node_mark pins one broadcast consumer per node.
+    for _ in range(args.nodes - 1):
+        cluster.add_node(num_cpus=2, resources={"node_mark": 1})
+    cluster.head_node  # head also serves
+    # The DRIVER issues the placement leases — it needs the long window too.
+    ray_tpu.init(address=cluster.address,
+                 _system_config={"lease_timeout_s": 240.0})
+
+    only = set((args.only or "tasks,actors,pgs,broadcast").split(","))
+    out: dict = {"metric": "scalability_envelope", "nodes": args.nodes}
+    try:
+        if "tasks" in only:
+            out["many_tasks"] = bench_many_tasks(args.tasks)
+            print("many_tasks:", out["many_tasks"], flush=True)
+        if "actors" in only:
+            out["many_actors"] = bench_many_actors(args.actors)
+            print("many_actors:", out["many_actors"], flush=True)
+        if "pgs" in only:
+            out["many_pgs"] = bench_many_pgs(args.pgs)
+            print("many_pgs:", out["many_pgs"], flush=True)
+        if "broadcast" in only:
+            out["broadcast"] = bench_broadcast(
+                args.broadcast_mb, args.nodes - 1)
+            print("broadcast:", out["broadcast"], flush=True)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    print(json.dumps(out), flush=True)
+    if args.json_out:
+        json.dump(out, open(args.json_out, "w"))
+
+
+if __name__ == "__main__":
+    main()

@@ -369,6 +369,22 @@ class CoreClient:
         self._memory_store[obj.binary()] = value
         return ObjectRef(obj)
 
+    async def _read_remote_chunks(self, oid: bytes,
+                                  size: int) -> bytearray | None:
+        """Assemble a large object over chunked reads (remote drivers).
+        None if the object vanished mid-read (caller retries the round)."""
+        chunk = self.config.remote_object_chunk_bytes
+        buf = bytearray(size)
+        for off in range(0, size, chunk):
+            n = min(chunk, size - off)
+            data = await self.raylet.call("obj_read_chunk", {
+                "object_id": oid, "offset": off, "length": n,
+            }, timeout=300)
+            if data is None:
+                return None
+            buf[off:off + n] = data
+        return buf
+
     async def _store_serialized(self, oid: bytes, head: bytes, views) -> None:
         """Write a serialized value into the node store under `oid`:
         inline below the cutoff, zero-copy extent write + seal above. Remote
@@ -383,9 +399,25 @@ class CoreClient:
         elif self.config.remote_object_plane:
             data = bytearray(size)
             serialization.write_to(memoryview(data), head, views)
-            await self.raylet.call("store_put_data", {
-                "object_id": oid, "data": bytes(data),
-            })
+            chunk = self.config.remote_object_chunk_bytes
+            if size <= chunk:
+                await self.raylet.call("store_put_data", {
+                    "object_id": oid, "data": bytes(data),
+                })
+            else:
+                # Stream in chunks: one frame per chunk instead of one
+                # giant frame (a 1 GiB+ put from a ray:// driver must not
+                # hit the RPC frame cap).
+                await self.raylet.call("store_create_remote", {
+                    "object_id": oid, "size": size})
+                mv = memoryview(data)
+                for off in range(0, size, chunk):
+                    await self.raylet.call("store_write_chunk", {
+                        "object_id": oid, "offset": off,
+                        "data": bytes(mv[off:off + chunk]),
+                    }, timeout=300)
+                await self.raylet.call("store_seal_remote", {
+                    "object_id": oid})
         else:
             resp = await self.raylet.call("store_create", {
                 "object_id": oid, "size": size,
@@ -446,6 +478,15 @@ class CoreClient:
                     continue
                 if loc == "inline":
                     value = serialization.unpack(data)
+                elif loc == "remote_chunked":
+                    # ray:// driver streaming a large object: assemble from
+                    # chunk reads (each its own RPC frame).
+                    buf = self._run(self._read_remote_chunks(key, data),
+                                    timeout=600)
+                    if buf is None:
+                        still.append((i, key))
+                        continue
+                    value = serialization.unpack(buf)
                 else:
                     name, offset, size = data
                     view = attach_extent(name, offset, size)
@@ -497,15 +538,28 @@ class CoreClient:
         mutations go through _lineage_lock."""
         with self._lineage_lock:
             self._evict_lineage_locked(oid)
+            # A freed dynamic ITEM (return index > 0) may have been the
+            # last thing pinning its generator's spec under the index-0 id.
+            o = ObjectID(oid)
+            if not o.is_put and o.return_index > 0:
+                self._evict_lineage_locked(
+                    ObjectID.for_return(o.task_id, 0).binary())
 
     def _evict_lineage_locked(self, oid: bytes) -> None:
         if self.refcounter.count(oid) > 0:
             return
         if self._lineage_deps.get(oid, 0) > 0:
             return
-        spec = self._lineage.pop(oid, None)
+        spec = self._lineage.get(oid)
         if spec is None:
             return
+        if spec.dynamic_returns and self.refcounter.has_live_with_task_prefix(
+                spec.task_id):
+            # Dynamic generator: live ITEM refs (same task prefix) must keep
+            # the spec pinned — replaying it is the only way to rebuild a
+            # lost item (their ids derive from the task id).
+            return
+        self._lineage.pop(oid, None)
         if any(rid in self._lineage for rid in spec.return_ids):
             return  # sibling returns still pin the spec
         self._lineage_budget.pop(spec.task_id, None)
@@ -527,6 +581,18 @@ class CoreClient:
 
     async def _recover_object(self, oid: bytes) -> bool:
         spec = self._lineage.get(oid)
+        if spec is None:
+            # Dynamic generator items (return index > 0) aren't individually
+            # pinned — their ids are derived from the creating task, so
+            # route through the task's index-0 lineage entry: replaying the
+            # generator re-stores every item under the SAME deterministic
+            # ids (worker._expand_dynamic uses for_return(task, i+1)).
+            o = ObjectID(oid)
+            if not o.is_put and o.return_index > 0:
+                root = ObjectID.for_return(o.task_id, 0).binary()
+                root_spec = self._lineage.get(root)
+                if root_spec is not None and root_spec.dynamic_returns:
+                    spec = root_spec
         if spec is None:
             # put() objects: the owner still holds the value — re-store it
             # (the reference instead fails puts; owning the value lets us
@@ -916,18 +982,43 @@ class CoreClient:
         return False
 
     async def _await_local_deps(self, spec: TaskSpec) -> None:
-        """Defer dispatch until ref args this client is still producing have
-        landed (ref: dependency_resolver.cc LocalDependencyResolver). Without
-        this, consumers occupy the bounded worker pool blocking on producers
-        that then can't get a worker — a deadlock, not just a slowdown.
-        Foreign refs (other clients' objects) resolve worker-side as before.
+        """Defer dispatch until ref args are known resolvable (ref:
+        dependency_resolver.cc LocalDependencyResolver). Without this,
+        consumers occupy the bounded worker pool blocking on producers that
+        then can't get a worker — a deadlock, not just a slowdown.
+
+        Two tiers: deps this client is still producing wait on the local
+        return event; FOREIGN refs (other clients' objects — e.g. a serve
+        replica consuming a driver's in-flight task output) wait for the
+        object to appear in the GCS directory before dispatch, closing the
+        cross-client variant of the same deadlock (r2 known limitation).
         """
+        foreign: list[bytes] = []
         for a in spec.args:
             if a.kind != "ref":
                 continue
             aev = self._return_ready.get(a.object_id)
             if aev is not None:
                 await aev.wait()
+            elif (a.object_id not in self._memory_store
+                  and not self.refcounter.is_owned(a.object_id)):
+                # Not ours and not locally resolvable: gate on the directory.
+                foreign.append(a.object_id)
+        for oid in foreign:
+            while not self._closed:
+                try:
+                    locs = await self.gcs.call(
+                        "obj_loc_get", {"object_id": oid}, timeout=30)
+                except Exception:
+                    locs = None
+                if locs or oid in self._memory_store:
+                    break
+                entry = (self._task_index.get(spec.return_ids[0])
+                         if spec.return_ids else None)
+                if getattr(entry, "state", None) == "done" or (
+                        isinstance(entry, dict) and entry.get("canceled")):
+                    return  # cancelled while waiting
+                await asyncio.sleep(self.config.foreign_dep_poll_interval_s)
 
     @staticmethod
     def _sched_key(spec: TaskSpec) -> tuple:

@@ -40,6 +40,17 @@ class Config:
     object_spill_threshold: float = 0.8
     # Directory for spilled objects (under session dir if relative).
     spill_dir: str = "spilled_objects"
+    # Cadence of a raylet's directory re-check while a store_get waits for
+    # a missing object (each round may trigger a pull / recovery).
+    object_pull_retry_interval_s: float = 1.0
+    # Concurrent chunk fetches within one object pull (windowed transfer).
+    object_pull_parallelism: int = 4
+    # Outbound serve slots per object (broadcast fan-out tree: pullers
+    # beyond this bound retry the directory, where completed pullers have
+    # registered as fresh holders — ref: push_manager.h:29).
+    object_serve_fanout: int = 3
+    # Reclaim a serve slot whose puller died after this long.
+    object_serve_slot_ttl_s: float = 120.0
 
     # --- scheduling ---
     # Hybrid policy: pack onto nodes below this utilization, then spread
@@ -99,6 +110,12 @@ class Config:
     # store_get probe window while a get() waits: every interval the client
     # re-checks liveness and triggers recovery for owned lost objects.
     get_probe_interval_s: float = 10.0
+    # Poll cadence while a task waits on a FOREIGN (cross-client) ref to
+    # appear in the object directory before dispatch.
+    foreign_dep_poll_interval_s: float = 0.3
+    # How long a worker retries its pre-reply ref flush before replying
+    # with unflushed acquires (the submitter then defers escrow release).
+    worker_preflush_window_s: float = 10.0
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
@@ -114,6 +131,10 @@ class Config:
     # the control plane is already plain TCP). Single-frame transfers:
     # objects up to rpc_max_frame_bytes.
     remote_object_plane: bool = False
+    # Remote drivers (ray://) stream objects bigger than this in chunks
+    # instead of one RPC frame (the reference's client proxies arbitrarily
+    # large objects via plasma chunking, util/client/).
+    remote_object_chunk_bytes: int = 64 * 1024**2
 
     # Stream worker stdout/stderr (user prints) to connected drivers
     # (ref: _private/log_monitor.py:100 → driver prints).

@@ -105,6 +105,13 @@ class Raylet:
         self._pulls_inflight: dict[bytes, asyncio.Future] = {}
         self._pull_bytes = 0          # admission accounting (bytes in flight)
         self._pull_waiters: list = []  # FIFO of (size, future)
+        # Outbound serve slots per object: token → expiry deadline.
+        # Bounding concurrent readers per object turns an N-node broadcast
+        # into a fan-out TREE — rejected pullers retry the directory, where
+        # freshly-completed pullers have registered as new holders, so a
+        # hot object propagates O(log N) waves deep instead of N serial
+        # reads off one node (ref: push_manager.h:29 push dedup/fanout).
+        self._serve_slots: dict[bytes, dict[str, float]] = {}
         self._peer_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._shutdown = False
         self._view_seen = 0            # last applied cluster-view version
@@ -124,6 +131,9 @@ class Raylet:
         s.register("store_seal", self._h_store_seal)
         s.register("store_put_inline", self._h_store_put_inline)
         s.register("store_put_data", self._h_store_put_data)
+        s.register("store_create_remote", self._h_store_create_remote)
+        s.register("store_write_chunk", self._h_store_write_chunk)
+        s.register("store_seal_remote", self._h_store_seal_remote)
         s.register("store_get", self._h_store_get)
         s.register("store_contains", self._h_store_contains)
         s.register("store_free", self._h_store_free)
@@ -136,7 +146,11 @@ class Raylet:
         # object plane (remote raylets)
         s.register("obj_read_chunk", self._h_obj_read_chunk)
         s.register("obj_info", self._h_obj_info)
+        s.register("obj_end_read", self._h_obj_end_read)
         s.register("node_info", self._h_node_info)
+        # log fetch (ref: dashboard/modules/log — browse + tail worker logs)
+        s.register("log_list", self._h_log_list)
+        s.register("log_fetch", self._h_log_fetch)
         s.on_disconnect(self._handle_disconnect)
 
     async def start(self) -> tuple[str, int]:
@@ -275,6 +289,13 @@ class Raylet:
         worker_id = WorkerID.from_random().binary()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = WorkerID(worker_id).hex()
+        # Defer the sitecustomize's eager jax import + PJRT registration
+        # (~2s of a ~2.1s worker boot): the worker re-arms it on first
+        # `import jax` (utils/lazy_axon.py). jax-free workers boot ~15x
+        # faster — actor/task spawn throughput is bounded by this.
+        if "PALLAS_AXON_POOL_IPS" in env:
+            env["RAY_TPU_DEFERRED_AXON_POOL_IPS"] = env.pop(
+                "PALLAS_AXON_POOL_IPS")
         if python is not None:
             # Venv interpreter (pip runtime env): ray_tpu itself isn't
             # installed into the venv — make it importable from the repo.
@@ -381,6 +402,16 @@ class Raylet:
         h.lease_resources = {}
         h.bundle_key = None
 
+    def _kill_worker(self, h: WorkerHandle) -> None:
+        """Ask an idle worker to exit and drop it from the pool now (its
+        capacity slot frees immediately for a replacement spawn)."""
+        if h.conn is not None:
+            try:
+                h.conn.notify("exit", {})
+            except Exception:
+                pass
+        self.workers.pop(h.worker_id, None)
+
     async def _reap_idle_loop(self) -> None:
         while not self._shutdown:
             await asyncio.sleep(5.0)
@@ -398,6 +429,39 @@ class Raylet:
     # ------------------------------------------------- log streaming
     # (ref: _private/log_monitor.py:100 — tail worker logs, publish via GCS
     #  pubsub so drivers print task/actor output live)
+
+    async def _h_log_list(self, conn, p):
+        """Worker/driver log files on this node (name, size, mtime)."""
+        log_dir = os.path.join(self.session_dir, "logs")
+        out = []
+        try:
+            for name in sorted(os.listdir(log_dir)):
+                path = os.path.join(log_dir, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                out.append({"name": name, "size": st.st_size,
+                            "mtime": st.st_mtime})
+        except OSError:
+            pass
+        return out
+
+    async def _h_log_fetch(self, conn, p):
+        """Tail of one log file (bounded; name is sanitized — the log dir
+        only, no path traversal)."""
+        name = os.path.basename(p["name"])
+        tail = min(int(p.get("tail_bytes", 64 * 1024)), 4 * 1024 * 1024)
+        path = os.path.join(self.session_dir, "logs", name)
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - tail))
+                data = f.read(tail)
+        except OSError:
+            return None
+        return {"name": name, "size": size,
+                "data": data.decode("utf-8", "replace")}
 
     async def _log_monitor_loop(self) -> None:
         offsets: dict[str, int] = {}
@@ -760,6 +824,19 @@ class Raylet:
                     int(self.resources_total.get("CPU", 1)) + n_pinned,
                     self.config.max_workers_per_node,
                 )
+                if len(self.workers) >= cap:
+                    # At capacity with only WRONG-env idle workers: evict
+                    # one to make room, or a pip-env lease starves forever
+                    # behind a kept-warm base worker (and vice versa) —
+                    # ref: worker_pool.cc pops an idle worker of another
+                    # runtime env for replacement.
+                    victim = next(
+                        (h for h in self.workers.values()
+                         if h.idle and h.conn is not None
+                         and h.actor_id is None
+                         and h.env_key != req.env_key), None)
+                    if victim is not None:
+                        self._kill_worker(victim)
                 if len(self.workers) < cap:
                     if req.env_key:
                         self._spawn_env_worker(req.env_key, req.pip_env or {})
@@ -876,6 +953,24 @@ class Raylet:
             self._announce_locations([p["object_id"]])
         return {"ok": True}
 
+    # Chunked remote-driver writes (objects above remote_object_chunk_bytes
+    # stream one frame per chunk; ref: the reference client's plasma
+    # chunking for arbitrarily large ray:// objects, util/client/).
+
+    async def _h_store_create_remote(self, conn, p):
+        await self.store.create(ObjectID(p["object_id"]), p["size"])
+        return {"ok": True}
+
+    async def _h_store_write_chunk(self, conn, p):
+        self.store.write_bytes(ObjectID(p["object_id"]), p["offset"],
+                               p["data"])
+        return {"ok": True}
+
+    async def _h_store_seal_remote(self, conn, p):
+        self.store.seal(ObjectID(p["object_id"]))
+        self._announce_locations([p["object_id"]])
+        return {"ok": True}
+
     async def _h_store_get(self, conn, p):
         """Resolve objects for a local client; pulls from remote if needed.
         Returns per-object: ("inline", bytes) | ("shm", (name, size)) |
@@ -900,7 +995,8 @@ class Raylet:
                 ok = await self._pull(obj, remaining)
                 if ok:
                     break
-                wait = 1.0 if remaining is None else min(1.0, remaining)
+                w = self.config.object_pull_retry_interval_s
+                wait = w if remaining is None else min(w, remaining)
                 ok = await self.store.wait_sealed(obj, wait)
             if not ok:
                 out.append(("missing", None))
@@ -911,6 +1007,9 @@ class Raylet:
                 if want_data:
                     e = self.store.entries.get(obj)
                     if e is not None and e.location == "spilled":
+                        if e.size > self.config.remote_object_chunk_bytes:
+                            out.append(("remote_chunked", e.size))
+                            continue
                         # Serve straight from the spill file: restoring into
                         # the arena just to copy bytes into the reply could
                         # evict live objects under pressure.
@@ -926,6 +1025,11 @@ class Raylet:
                 if loc == "shm":
                     if want_data:
                         _arena, _off, size = data
+                        if size > self.config.remote_object_chunk_bytes:
+                            # Client streams via obj_read_chunk: one frame
+                            # per chunk instead of one giant reply.
+                            out.append(("remote_chunked", size))
+                            continue
                         out.append(("inline",
                                     self.store.read_bytes(obj, 0, size)))
                         continue
@@ -978,14 +1082,50 @@ class Raylet:
         obj = ObjectID(p["object_id"])
         if not self.store.contains(obj):
             return None
-        return {"size": self.store.entries[obj].size,
+        info = {"size": self.store.entries[obj].size,
                 "inline": self.store.entries[obj].location == "inline"}
+        # Bulk transfers reserve a serve slot (tree fan-out — see
+        # _serve_slots); inline reads are one small RPC, never gated.
+        if p.get("want_serve") and not info["inline"]:
+            tok = self._serve_acquire(obj.binary())
+            if tok is None:
+                return {"busy": True}
+            info["serve_token"] = tok
+        return info
 
     async def _h_obj_read_chunk(self, conn, p):
         obj = ObjectID(p["object_id"])
         if not self.store.contains(obj):
             return None
         return self.store.read_bytes(obj, p["offset"], p["length"])
+
+    def _serve_acquire(self, key: bytes) -> str | None:
+        """→ slot token, or None when the object's reader bound is full.
+        Tokened so a release always frees the RELEASER's slot — popping an
+        arbitrary entry would let a straggler free a live puller's slot
+        and drift the bound above the fanout."""
+        import uuid
+
+        now = time.monotonic()
+        slots = self._serve_slots.setdefault(key, {})
+        for tok in [t for t, d in slots.items() if d <= now]:
+            slots.pop(tok, None)
+        if len(slots) >= self.config.object_serve_fanout:
+            return None
+        tok = uuid.uuid4().hex[:16]
+        slots[tok] = now + self.config.object_serve_slot_ttl_s
+        return tok
+
+    def _serve_release(self, key: bytes, token: str) -> None:
+        slots = self._serve_slots.get(key)
+        if slots is not None:
+            slots.pop(token, None)
+            if not slots:
+                self._serve_slots.pop(key, None)
+
+    async def _h_obj_end_read(self, conn, p):
+        self._serve_release(p["object_id"], p.get("token", ""))
+        return {"ok": True}
 
     async def _peer(self, address: tuple[str, int]) -> rpc.Connection:
         conn = self._peer_conns.get(address)
@@ -1020,51 +1160,83 @@ class Raylet:
             self._pulls_inflight.pop(key, None)
 
     async def _pull_once(self, obj: ObjectID, timeout: float | None) -> bool:
-        locs = await self.gcs.call("obj_loc_get", {"object_id": obj.binary()})
-        if not locs:
-            # No live copy anywhere: route a reconstruction request to the
-            # owner (ref: object_recovery_manager.h RecoverObject); we keep
-            # polling the directory on subsequent store_get rounds.
-            try:
-                await self.gcs.call("obj_request_recovery", {
-                    "object_ids": [obj.binary()]}, timeout=10.0)
-            except Exception:
-                pass
-            return False
-        # Randomize holder order so a broadcast (N nodes pulling one hot
-        # object) spreads across replicas as copies appear, instead of
-        # serializing on the original holder (ref: push_manager.h dedup +
-        # pull location selection).
         import random
 
-        locs = [l for l in locs if l["node_id"] != self.node_id]
-        random.shuffle(locs)
-        for loc in locs:
-            try:
-                peer = await self._peer(tuple(loc["address"]))
-                info = await peer.call("obj_info", {"object_id": obj.binary()},
-                                       timeout=10.0)
-                if info is None:
+        deadline = (time.monotonic() + timeout) if timeout else None
+        backoff = 0.1
+        while True:
+            locs = await self.gcs.call(
+                "obj_loc_get", {"object_id": obj.binary()})
+            if not locs:
+                # No live copy anywhere: route a reconstruction request to
+                # the owner (ref: object_recovery_manager.h RecoverObject);
+                # we keep polling the directory on later store_get rounds.
+                try:
+                    await self.gcs.call("obj_request_recovery", {
+                        "object_ids": [obj.binary()]}, timeout=10.0)
+                except Exception:
+                    pass
+                return False
+            # Randomize holder order so a broadcast (N nodes pulling one hot
+            # object) spreads across replicas as copies appear, instead of
+            # serializing on the original holder (ref: push_manager.h dedup
+            # + pull location selection).
+            locs = [l for l in locs if l["node_id"] != self.node_id]
+            random.shuffle(locs)
+            saw_busy = False
+            for loc in locs:
+                try:
+                    peer = await self._peer(tuple(loc["address"]))
+                    info = await peer.call(
+                        "obj_info",
+                        {"object_id": obj.binary(), "want_serve": True},
+                        timeout=10.0)
+                    if info is None:
+                        continue
+                    if info.get("busy"):
+                        # Holder's serve slots are full (broadcast wave):
+                        # try another holder; if all are saturated, back
+                        # off and re-read the directory — completed pullers
+                        # will have registered as fresh holders (tree
+                        # fan-out instead of N pulls on one node).
+                        saw_busy = True
+                        continue
+                    size = info["size"]
+                    if info["inline"]:
+                        data = await peer.call("obj_read_chunk", {
+                            "object_id": obj.binary(), "offset": 0,
+                            "length": size,
+                        }, timeout=60.0)
+                        self.store.put_inline(obj, data)
+                    else:
+                        try:
+                            await self._pull_admission(size)
+                            try:
+                                await self._pull_chunks(obj, peer, size)
+                            finally:
+                                self._pull_release(size)
+                        finally:
+                            try:
+                                await peer.call("obj_end_read", {
+                                    "object_id": obj.binary(),
+                                    "token": info.get("serve_token", ""),
+                                }, timeout=5.0)
+                            except Exception:
+                                pass  # slot TTL reclaims it
+                    await self.gcs.call("obj_loc_add", {
+                        "object_ids": [obj.binary()],
+                        "node_id": self.node_id,
+                    })
+                    return True
+                except (rpc.RpcError, rpc.ConnectionLost, KeyError) as e:
+                    logger.debug("pull from %s failed: %s", loc, e)
                     continue
-                size = info["size"]
-                if info["inline"]:
-                    data = await peer.call("obj_read_chunk", {
-                        "object_id": obj.binary(), "offset": 0, "length": size,
-                    }, timeout=60.0)
-                    self.store.put_inline(obj, data)
-                else:
-                    await self._pull_admission(size)
-                    try:
-                        await self._pull_chunks(obj, peer, size)
-                    finally:
-                        self._pull_release(size)
-                await self.gcs.call("obj_loc_add", {
-                    "object_ids": [obj.binary()], "node_id": self.node_id,
-                })
-                return True
-            except (rpc.RpcError, rpc.ConnectionLost, KeyError) as e:
-                logger.debug("pull from %s failed: %s", loc, e)
+            if saw_busy and (deadline is None
+                             or time.monotonic() + backoff < deadline):
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 1.6, 1.0)
                 continue
+            break
         # Every holder failed: abort any partially-created unsealed extent
         # so the arena doesn't leak it (a later retry re-creates it).
         e = self.store.entries.get(obj)
@@ -1107,7 +1279,7 @@ class Raylet:
         chunk = self.config.object_transfer_chunk_size
         await self.store.create(obj, size)
         offsets = list(range(0, size, chunk))
-        sem = asyncio.Semaphore(4)
+        sem = asyncio.Semaphore(self.config.object_pull_parallelism)
 
         async def fetch(off: int):
             async with sem:

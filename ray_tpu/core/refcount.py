@@ -84,6 +84,18 @@ class ReferenceCounter:
             with self._lock:
                 self._owned.add(oid)
 
+    def is_owned(self, oid: bytes) -> bool:
+        """Created by this process (put / submitted task return)?"""
+        with self._lock:
+            return oid in self._owned
+
+    def has_live_with_task_prefix(self, prefix: bytes) -> bool:
+        """Any locally-held ref whose object id starts with `prefix` (the
+        20-byte task id)? Used to keep a dynamic generator's lineage pinned
+        while its ITEM refs are alive even after the outer list is freed."""
+        with self._lock:
+            return any(oid.startswith(prefix) for oid in self._counts)
+
     def pending_acquire_ids(self) -> list[bytes]:
         """Acquires the GCS has not (confirmably) seen yet — reported to task
         submitters when a pre-reply flush cannot land (GCS outage) so their

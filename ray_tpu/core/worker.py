@@ -322,7 +322,8 @@ class Worker:
         if api._client is not None:
             counter = api._client.refcounter
             deadline = time.time() + min(
-                10.0, self.config.gcs_reconnect_window_s)
+                self.config.worker_preflush_window_s,
+                self.config.gcs_reconnect_window_s)
             delay = 0.5
             while True:
                 try:
@@ -590,6 +591,9 @@ def main() -> None:
     ap.add_argument("--worker-id", required=True)
     ap.add_argument("--session-dir", required=True)
     args = ap.parse_args()
+    from ray_tpu.utils.lazy_axon import install as _lazy_axon_install
+
+    _lazy_axon_install()
     logging.basicConfig(level=logging.INFO,
                         format="[worker] %(levelname)s %(message)s")
     rhost, rport = args.raylet.rsplit(":", 1)

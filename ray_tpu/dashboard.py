@@ -47,6 +47,29 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         try:
+            from urllib.parse import parse_qs, urlparse
+
+            parsed = urlparse(self.path)
+            if parsed.path in ("/", "/index.html"):
+                body = _UI_HTML.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if parsed.path == "/api/logs":
+                q = parse_qs(parsed.query)
+                return self._json(state.list_logs(
+                    q.get("node_id", [None])[0]))
+            m = re.fullmatch(r"/api/logs/([0-9a-f]+)/([^/]+)", parsed.path)
+            if m:
+                q = parse_qs(parsed.query)
+                tail = int(q.get("tail_bytes", ["65536"])[0])
+                info = state.fetch_log(m.group(1), m.group(2), tail)
+                if info is None:
+                    return self._json({"error": "not found"}, 404)
+                return self._json(info)
             if self.path == "/api/cluster_status":
                 return self._json(state.cluster_status())
             if self.path == "/api/nodes":
@@ -102,6 +125,67 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": "unknown endpoint"}, 404)
         except Exception as e:
             self._json({"error": repr(e)}, 500)
+
+
+# Minimal single-page UI over the JSON API (the reference ships a React
+# app, dashboard/client/; a build-step-free page covers the same browse
+# loop: cluster summary, nodes, actors, jobs, per-node log tailing).
+_UI_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.2rem;background:#fafafa}
+ h1{font-size:1.2rem} h2{font-size:1rem;margin-top:1.4rem}
+ table{border-collapse:collapse;font-size:.85rem;background:#fff}
+ th,td{border:1px solid #ddd;padding:.25rem .5rem;text-align:left}
+ th{background:#f0f0f0} pre{background:#111;color:#dfd;padding:.6rem;
+ font-size:.75rem;max-height:24rem;overflow:auto}
+ .pill{display:inline-block;padding:0 .5rem;border-radius:.6rem}
+ .ok{background:#cfc}.bad{background:#fcc}
+ a{cursor:pointer;color:#06c;text-decoration:underline}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="status"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Logs</h2><div id="logfiles"></div><pre id="logview">select a file…</pre>
+<script>
+const J = async p => (await fetch(p)).json();
+const cell = v => typeof v==='object'? JSON.stringify(v): String(v ?? '');
+function table(el, rows, cols){
+  if(!rows || !rows.length){el.innerHTML='<tr><td>(none)</td></tr>';return;}
+  cols = cols || Object.keys(rows[0]);
+  el.innerHTML = '<tr>'+cols.map(c=>'<th>'+c+'</th>').join('')+'</tr>'+
+    rows.map(r=>'<tr>'+cols.map(c=>'<td>'+cell(r[c])+'</td>').join('')+
+    '</tr>').join('');
+}
+async function refresh(){
+  const s = await J('/api/cluster_status');
+  document.getElementById('status').innerHTML =
+    '<span class="pill ok">'+(s.alive_nodes ?? '?')+' nodes</span> ' +
+    '<span class="pill">'+cell(s.resources_total ?? s.total ?? {})+'</span>';
+  table(document.getElementById('nodes'), await J('/api/nodes'),
+        ['node_id','alive','address','resources_total']);
+  table(document.getElementById('actors'), await J('/api/actors'),
+        ['actor_id','name','state','node_id','num_restarts']);
+  table(document.getElementById('jobs'), await J('/api/jobs/'));
+  const logs = await J('/api/logs');
+  let html='';
+  for(const [node, files] of Object.entries(logs)){
+    html += '<b>'+node.slice(0,8)+'</b>: ' + files.map(f =>
+      '<a onclick="show(\\''+node+'\\',\\''+f.name+'\\')">'+f.name+
+      '</a> ('+f.size+'B)').join(' · ') + '<br>';
+  }
+  document.getElementById('logfiles').innerHTML = html || '(no logs)';
+}
+async function show(node, name){
+  const r = await J('/api/logs/'+node+'/'+name);
+  document.getElementById('logview').textContent =
+    r.data ?? JSON.stringify(r);
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
 
 
 class Dashboard:

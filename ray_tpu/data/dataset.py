@@ -395,9 +395,14 @@ class Dataset:
                     f"column {name!r} has non-numeric rows (dtype=object); "
                     "torch tensors need numeric columns — map/encode it "
                     "first")
-            # Copy: arrow-backed numpy views are read-only, and wrapping
-            # them zero-copy yields tensors whose in-place ops are UB.
-            return torch.as_tensor(np.ascontiguousarray(arr), device=device)
+            # Copy read-only views: ascontiguousarray alone passes a
+            # CONTIGUOUS read-only (mmap/arrow-backed) array through
+            # untouched, and wrapping it zero-copy yields tensors whose
+            # in-place ops are undefined behavior (torch warns).
+            arr = np.ascontiguousarray(arr)
+            if not arr.flags.writeable:
+                arr = arr.copy()
+            return torch.as_tensor(arr, device=device)
 
         for batch in self.iter_batches(batch_size=batch_size,
                                        batch_format="numpy",

@@ -182,6 +182,8 @@ class DeploymentHandle:
             if not client.actor_state(r._actor_id.binary()).dead
         ]
 
+    COLD_START_TIMEOUT_S = 60.0
+
     def _pick_replica(self):
         import random
 
@@ -196,12 +198,39 @@ class DeploymentHandle:
                 replicas = self._alive(self._replicas)
             if replicas and not stale:
                 break
-            self._refresh(force=not replicas)
+            try:
+                self._refresh(force=not replicas)
+            except Exception:
+                pass  # controller mid-restart: serve from cache below
             with self._lock:
                 replicas = self._alive(self._replicas)
             if replicas:
                 break
             time.sleep(0.3 * (attempt + 1))
+        if not replicas:
+            # Scale-to-zero wake-up: ask the controller for a cold start
+            # and wait for the first replica (ref: the handle-queue-driven
+            # upscale in serve/_private/autoscaling_policy.py). A False
+            # verdict means the deployment doesn't exist (deleted/typo) —
+            # fail fast instead of burning the cold-start window.
+            woke = False
+            try:
+                ctrl = _get_controller()
+                woke = ray_tpu.get(ctrl.request_scale_up.remote(
+                    self.deployment_name), timeout=30)
+            except Exception:
+                pass
+            deadline = time.monotonic() + self.COLD_START_TIMEOUT_S
+            while woke and time.monotonic() < deadline:
+                time.sleep(0.5)
+                try:
+                    self._refresh(force=True)
+                except Exception:
+                    continue
+                with self._lock:
+                    replicas = self._alive(self._replicas)
+                if replicas:
+                    break
         if not replicas:
             raise RuntimeError(
                 f"no replicas for deployment {self.deployment_name!r}"

@@ -129,10 +129,12 @@ class ServeController:
         if autoscaling_config:
             ac = dict(autoscaling_config)
             ac.setdefault("min_replicas", 1)
-            # Scale-to-zero is unsupported: with zero replicas there is no
-            # load signal to scale back up from (the reference measures
-            # handle-side queues; here metrics come from replicas).
-            ac["min_replicas"] = max(1, ac["min_replicas"])
+            # min_replicas=0 == scale-to-zero: with no replicas there is no
+            # replica-side load signal, so the scale-UP trigger moves to the
+            # caller — a handle that finds zero replicas calls
+            # request_scale_up() and waits for the cold start (the
+            # reference's handle-queue-driven path, autoscaling_policy.py).
+            ac["min_replicas"] = max(0, ac["min_replicas"])
             ac.setdefault("max_replicas", max(num_replicas, 1))
             ac.setdefault("target_ongoing_requests", 2.0)
             ac.setdefault("upscale_delay_s", 0.5)
@@ -207,6 +209,23 @@ class ServeController:
                     "max_concurrent_queries": d["max_concurrent_queries"],
                 }
         return {"version": self.version, "routes": routes}
+
+    def request_scale_up(self, name: str) -> bool:
+        """Cold-start trigger from a handle that found zero replicas (the
+        scale-to-zero wake-up path). Reconciles immediately so the caller's
+        wait is one replica startup, not a reconcile tick + startup."""
+        with self._lock:
+            d = self.deployments.get(name)
+            if d is None:
+                return False
+            if d["num_replicas"] < 1:
+                d["num_replicas"] = 1
+                d["under_since"] = None
+                d["over_since"] = None
+            else:
+                return True
+        self._reconcile_once()
+        return True
 
     def is_member(self, deployment: str, actor_id_hex: str) -> bool:
         """Replica orphan check (see replica._membership_loop)."""

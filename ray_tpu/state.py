@@ -154,6 +154,46 @@ def prometheus_metrics() -> str:
     return profiling.prometheus_text(metrics_rows())
 
 
+def _call_raylet_addr(address, method: str, payload: dict) -> Any:
+    async def go():
+        conn = await rpc.connect(*tuple(address), timeout=5.0)
+        try:
+            return await conn.call(method, payload, timeout=30.0)
+        finally:
+            await conn.close()
+
+    try:
+        return asyncio.run(go())
+    except Exception:
+        return None
+
+
+def list_logs(node_id: str | None = None) -> dict:
+    """node_id(hex, prefix ok) → its log files; all alive nodes if None
+    (ref: dashboard/modules/log list API). One cluster-view fetch total."""
+    out = {}
+    for n in list_nodes():
+        if not n.get("alive", True):
+            continue
+        if node_id is not None and not n["node_id"].startswith(node_id):
+            continue
+        files = _call_raylet_addr(n["address"], "log_list", {})
+        out[n["node_id"]] = files or []
+    return out
+
+
+def fetch_log(node_id: str, name: str,
+              tail_bytes: int = 64 * 1024) -> dict | None:
+    """Tail of one worker/driver log file on `node_id` (hex, prefix ok)."""
+    node = next((n for n in list_nodes()
+                 if n["node_id"].startswith(node_id)
+                 and n.get("alive", True)), None)
+    if node is None:
+        return None
+    return _call_raylet_addr(node["address"], "log_fetch",
+                             {"name": name, "tail_bytes": tail_bytes})
+
+
 def cluster_status() -> dict:
     """Summary used by `status` CLI and the dashboard."""
     nodes = list_nodes()

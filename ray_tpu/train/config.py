@@ -47,6 +47,9 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig
     )
+    # Mirror the experiment dir to durable storage (tune/syncer.py
+    # SyncConfig; ref: tune/syncer.py upload_dir).
+    sync_config: Any = None
     verbose: int = 0
 
 

@@ -255,3 +255,116 @@ class TPESearcher(Searcher):
             if best_score is None or s > best_score:
                 best_cfg, best_score = cfg, s
         return best_cfg
+
+
+class BayesOptSearcher(Searcher):
+    """Gaussian-process + expected-improvement searcher — the role BayesOpt
+    /Ax/HEBO integrations play for the reference (`tune/search/bayesopt`),
+    implemented natively on numpy (no external dependency, zero-egress
+    image). Continuous/int domains are modeled in a normalized [0,1] GP
+    (log-warped for LogUniform); choice/grid dims fall back to good-trial
+    histogram sampling (mirroring TPESearcher) since a GP needs a metric
+    space.
+    """
+
+    def __init__(self, param_space: dict, metric: str, mode: str = "max",
+                 seed: int | None = None, n_initial: int = 5,
+                 n_candidates: int = 128, length_scale: float = 0.2,
+                 noise: float = 1e-3, xi: float = 0.01):
+        super().__init__(param_space, seed)
+        self.metric = metric
+        self.sign = 1.0 if mode == "max" else -1.0
+        self.n_initial = n_initial
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+        self.xi = xi
+        self._observed: list[tuple[dict, float]] = []
+        self._cont_keys = [
+            k for k, v in param_space.items()
+            if isinstance(v, (Uniform, LogUniform, Randint))
+        ]
+
+    # -- observation plumbing (same contract as TPESearcher) --
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        if result and self.metric in result:
+            self._observed.append(
+                (dict(result["config"]) if "config" in result else {},
+                 self.sign * result[self.metric]))
+
+    def observe(self, config: dict, value: float) -> None:
+        self._observed.append((config, self.sign * value))
+
+    # -- GP machinery --
+
+    def _encode(self, cfg: dict):
+        import numpy as np
+
+        x = []
+        for k in self._cont_keys:
+            d = self.param_space[k]
+            v = cfg.get(k)
+            if v is None:
+                x.append(0.5)
+            elif isinstance(d, LogUniform):
+                lo, hi = math.log(d.low), math.log(d.high)
+                x.append((math.log(v) - lo) / (hi - lo))
+            else:
+                x.append((v - d.low) / (d.high - d.low))
+        return np.asarray(x, float)
+
+    def _gp_posterior(self, X, y, Xc):
+        import numpy as np
+
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * self.length_scale ** 2))
+
+        K = k(X, X) + self.noise * np.eye(len(X))
+        Ks = k(X, Xc)
+        Kss = np.ones(len(Xc))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+        mu = Ks.T @ alpha
+        v = np.linalg.solve(L, Ks)
+        var = np.maximum(Kss - (v ** 2).sum(0), 1e-12)
+        return mu, np.sqrt(var)
+
+    def suggest(self, trial_id: str) -> dict:
+        import numpy as np
+
+        if len(self._observed) < self.n_initial or not self._cont_keys:
+            return self._sample_space()
+        X = np.stack([self._encode(c) for c, _ in self._observed])
+        y = np.asarray([v for _, v in self._observed], float)
+        y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+        yn = (y - y_mean) / y_std
+
+        cands = [self._sample_space() for _ in range(self.n_candidates)]
+        Xc = np.stack([self._encode(c) for c in cands])
+        try:
+            mu, sigma = self._gp_posterior(X, yn, Xc)
+        except np.linalg.LinAlgError:
+            return self._sample_space()
+        best = yn.max()
+        # Expected improvement
+        z = (mu - best - self.xi) / sigma
+        phi = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+        Phi = 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = sigma * (z * Phi + phi)
+        chosen = dict(cands[int(np.argmax(ei))])
+        # Non-metric dims: bias toward the best half's histogram.
+        cat_keys = [k for k, v in self.param_space.items()
+                    if isinstance(v, (Choice, GridSearch))]
+        if cat_keys and len(self._observed) >= 2:
+            order = sorted(self._observed, key=lambda o: -o[1])
+            good = order[: max(1, len(order) // 2)]
+            for k in cat_keys:
+                d = self.param_space[k]
+                options = (d.options if isinstance(d, Choice) else d.values)
+                vals = [g[k] for g, _ in good if k in g]
+                weights = [1 + sum(1 for v in vals if v == o)
+                           for o in options]
+                chosen[k] = self.rng.choices(options, weights=weights)[0]
+        return chosen

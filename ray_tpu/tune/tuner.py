@@ -157,12 +157,25 @@ class Tuner:
 
     @classmethod
     def restore(cls, path: str, trainable: Callable, **kwargs) -> "Tuner":
-        """Resume an experiment from `storage_path/name`. Finished trials
-        keep their results; unfinished trials restart from their last
+        """Resume an experiment from `storage_path/name` — or from a synced
+        storage URI (e.g. "file://bucket/exp": downloaded to a local dir
+        first; ref tune/syncer.py cloud restore). Finished trials keep
+        their results; unfinished trials restart from their last
         checkpoint."""
         import os
         import pickle
 
+        if "://" in path:
+            import tempfile
+
+            from ray_tpu.tune.syncer import Syncer
+
+            # Fresh dir per restore: a fixed shared path would merge stale
+            # files from earlier restores of a same-named experiment (and
+            # collide across users on shared machines).
+            local = tempfile.mkdtemp(prefix="ray_tpu_restored_")
+            Syncer.download_experiment(path, local)
+            path = local
         with open(os.path.join(path, "tuner.pkl"), "rb") as f:
             saved = pickle.load(f)
         storage_path, name = os.path.split(path.rstrip("/"))
@@ -191,6 +204,13 @@ class Tuner:
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
         searcher = tc.search_alg
+        syncer = None
+        if (self.run_config.sync_config is not None
+                and self._experiment_dir() is not None):
+            from ray_tpu.tune.syncer import Syncer
+
+            syncer = Syncer(self.run_config.sync_config,
+                            self.run_config.name or "experiment")
         if self._restored_trials is not None:
             trials = self._restored_trials
         elif searcher is not None:
@@ -319,7 +339,17 @@ class Tuner:
                     finish(t)
             if dirty:  # avoid rewriting unchanged state every poll tick
                 self._save_experiment(trials)
+                if syncer is not None:
+                    try:
+                        syncer.sync_up_if_due(self._experiment_dir())
+                    except Exception:
+                        pass  # sync is durability, not correctness
         self._save_experiment(trials)
+        if syncer is not None:
+            try:
+                syncer.sync_up(self._experiment_dir())
+            except Exception:
+                pass
         return ResultGrid(trials, tc.metric, tc.mode)
 
     def _fetch_checkpoint(self, t: Trial):

@@ -239,3 +239,49 @@ def test_large_object_transfer_and_broadcast(two_node_cluster):
     a, b = ray_tpu.get(
         [on_special.remote(ref), anywhere.remote(ref)], timeout=180)
     assert a[0] == want and b == want
+
+
+
+def test_cross_client_dep_does_not_hold_worker():
+    """Producer-consumer deadlock, cross-client variant (r2 known
+    limitation): an ACTOR-submitted task (actors are their own core
+    clients) whose arg is the driver's not-yet-produced task output must
+    resolve correctly: dispatch gates on the GCS directory
+    (client._await_local_deps foreign-ref tier), so the consumer does not
+    occupy the lone CPU worker while the producer still needs it."""
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        def warm():
+            return 1
+
+        assert ray_tpu.get(warm.remote(), timeout=60) == 1  # pool warm
+
+        @ray_tpu.remote(num_cpus=0)
+        def slow_gate():
+            import time as _t
+
+            _t.sleep(1.0)
+            return 1
+
+        @ray_tpu.remote
+        def produce(_gate):
+            return 41
+
+        @ray_tpu.remote(num_cpus=0)
+        class Submitter:
+            def consume(self, dep):
+                @ray_tpu.remote
+                def use(x):
+                    return x + 1
+
+                return ray_tpu.get(use.remote(dep), timeout=90)
+
+        sub = Submitter.remote()
+        dep = produce.remote(slow_gate.remote())  # dispatch gated ~1s
+        out_ref = sub.consume.remote(dep)         # races for the CPU worker
+        assert ray_tpu.get(out_ref, timeout=90) == 42
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
