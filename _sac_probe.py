@@ -1,0 +1,21 @@
+import time
+import numpy as np
+from ray_tpu.utils.platform import force_cpu_devices
+force_cpu_devices(1)
+import ray_tpu
+ray_tpu.init(num_cpus=1)
+from ray_tpu.rllib import SACConfig
+cfg = (SACConfig()
+       .environment("Pendulum-v1", seed=0)
+       .rollouts(num_envs_per_worker=8)
+       .training(train_batch_size=64, learning_starts=1000,
+                 sgd_rounds_per_step=64, lr=1e-3))
+algo = cfg.build()
+t0=time.perf_counter()
+for it in range(400):
+    res = algo.train()
+    if it % 25 == 0 or it == 399:
+        print(f"it={it} t={time.perf_counter()-t0:.0f}s steps={res['timesteps_total']} "
+              f"ret={res.get('episode_return_mean')} alpha={res.get('alpha')} "
+              f"q={res.get('q_loss')} pi={res.get('pi_loss')}", flush=True)
+algo.stop(); ray_tpu.shutdown()

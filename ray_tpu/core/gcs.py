@@ -483,7 +483,18 @@ class GcsServer:
 
     def _schedule_actor(self, resources: dict[str, float]) -> NodeInfo | None:
         """Central actor scheduling: least-loaded feasible node
-        (ref: gcs_actor_scheduler.cc:49)."""
+        (ref: gcs_actor_scheduler.cc:49).
+
+        Live-actor count dominates the score: every actor pins a worker
+        PROCESS, so tiny-resource actors must spread by process count, not
+        by fractional resource arithmetic — ranking by available-resource
+        sum alone parks every 0.001-CPU actor on the biggest node until it
+        exhausts its worker cap (found by the many-actors envelope bench).
+        """
+        live_by_node: dict[bytes, int] = {}
+        for a in self.actors.values():
+            if a.state != DEAD and a.node_id is not None:
+                live_by_node[a.node_id] = live_by_node.get(a.node_id, 0) + 1
         best, best_score = None, None
         for n in self.nodes.values():
             if not n.alive:
@@ -495,7 +506,8 @@ class GcsServer:
             avail = all(
                 n.resources_available.get(k, 0) >= v for k, v in resources.items()
             )
-            score = (not avail, n.load, -sum(n.resources_available.values()))
+            score = (not avail, live_by_node.get(n.node_id, 0), n.load,
+                     -sum(n.resources_available.values()))
             if best_score is None or score < best_score:
                 best, best_score = n, score
         return best

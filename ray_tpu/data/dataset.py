@@ -72,6 +72,11 @@ def _map_block_task(fn_packed, blk):
     return fn(blk)
 
 
+@ray_tpu.remote
+def _block_size_task(blk):
+    return B.size_bytes(blk)
+
+
 _LAST_STAGE_STATS: dict = {}
 
 
@@ -85,6 +90,7 @@ class Dataset:
     def __init__(self, block_refs: list, stages: list | None = None):
         self._block_refs = list(block_refs)
         self._stages: list = stages or []
+        self._stats: list[dict] = []   # per-stage execution records
 
     # ------------------------------------------------------------ plan
 
@@ -93,30 +99,63 @@ class Dataset:
 
     def materialize(self) -> "Dataset":
         """Execute all pending stages (fusing adjacent map stages)."""
+        import time as _time
+
         from ray_tpu.core import serialization
 
+        stats: list[dict] = list(self._stats)
         refs = self._block_refs
         i = 0
         while i < len(self._stages):
+            t0 = _time.perf_counter()
             stage = self._stages[i]
             if isinstance(stage, MapStage):
                 fns = []
+                names = []
                 while i < len(self._stages) and isinstance(
                     self._stages[i], MapStage
                 ):
                     fns.append(self._stages[i].fn)
+                    names.append(self._stages[i].name)
                     i += 1
                 packed = serialization.pack(_fused_map(fns))
                 refs = [_map_block_task.remote(packed, r) for r in refs]
+                # Fused map stages are lazy tasks: charge their wall time
+                # when the blocks are consumed (here: submit latency only).
+                name = "+".join(names)
             elif isinstance(stage, ActorMapStage):
                 from ray_tpu.data.compute import run_actor_map
 
                 refs = run_actor_map(stage.ctor_packed, refs, stage.compute)
+                name = f"{stage.name}[actor_pool]"
                 i += 1
             else:
                 refs = stage.fn(refs)
+                name = stage.name
                 i += 1
-        return Dataset(refs, [])
+            stats.append({"stage": name, "blocks": len(refs),
+                          "wall_s": round(_time.perf_counter() - t0, 4)})
+        out = Dataset(refs, [])
+        out._stats = stats
+        return out
+
+    def stats(self) -> str:
+        """Human-readable per-stage execution summary (the reference's
+        DatasetStats surface, `data/_internal/stats.py`): one line per
+        executed stage with block count + wall time; shuffle stages add
+        their push-shuffle round details from last_stage_stats()."""
+        if self._stages:
+            return self.materialize().stats()
+        if not self._stats:
+            return "(no executed stages)"
+        lines = [
+            f"Stage {i}: {s['stage']}: {s['blocks']} blocks, "
+            f"{s['wall_s']}s" for i, s in enumerate(self._stats)
+        ]
+        extra = last_stage_stats()
+        if extra:
+            lines.append(f"last all-to-all: {extra}")
+        return "\n".join(lines)
 
     def _materialized_refs(self) -> list:
         return self.materialize()._block_refs if self._stages else self._block_refs
@@ -191,9 +230,25 @@ class Dataset:
 
     # ------------------------------------------------------------ all-to-all
 
-    def repartition(self, num_blocks: int) -> "Dataset":
+    def repartition(self, num_blocks: int | None = None, *,
+                    target_block_size_bytes: int | None = None) -> "Dataset":
+        """Rebalance into `num_blocks`, or — size-aware — into blocks of
+        ~`target_block_size_bytes` each (the reference's block-size-aware
+        splitting, `data/context.py target_max_block_size`): total bytes
+        are measured remotely and the block count derived, so huge blocks
+        split and slivers merge without the caller knowing sizes."""
+        if (num_blocks is None) == (target_block_size_bytes is None):
+            raise ValueError(
+                "pass exactly one of num_blocks / target_block_size_bytes")
+
         def do(refs):
-            return _repartition(refs, num_blocks)
+            n = num_blocks
+            if n is None:
+                sizes = ray_tpu.get(
+                    [_block_size_task.remote(r) for r in refs], timeout=600)
+                total = sum(sizes)
+                n = max(1, round(total / max(target_block_size_bytes, 1)))
+            return _repartition(refs, n)
 
         return self._with_stage(AllToAllStage("repartition", do))
 

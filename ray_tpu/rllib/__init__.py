@@ -1,7 +1,7 @@
 """RLlib-equivalent: scalable reinforcement learning on the TPU runtime.
 
 Parity: `/root/reference/rllib/` — Algorithm/AlgorithmConfig driver,
-WorkerSet of rollout actors, policy abstraction, replay buffers, PPO/A2C/DQN.
+WorkerSet of rollout actors, policy abstraction, replay buffers, PPO/A2C/DQN/SAC.
 Compute is functional JAX (jitted sampling + donated SGD steps); rollouts
 are numpy vector envs on host actors.
 """
@@ -18,13 +18,14 @@ from ray_tpu.rllib.env import (
 )
 from ray_tpu.rllib.policy import Policy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
     "A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig",
-    "DQN", "DQNConfig",
+    "DQN", "DQNConfig", "SAC", "SACConfig",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
     "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",
     "Pendulum", "make_env", "register_env",

@@ -1,0 +1,219 @@
+"""SAC: soft actor-critic for continuous control.
+
+Parity: `/root/reference/rllib/algorithms/sac/` — off-policy replay, twin
+Q networks with a polyak-averaged target pair, a tanh-squashed Gaussian
+policy trained on the reparameterized entropy-regularized objective, and
+automatic entropy-temperature tuning toward -|A|. One jitted update step
+(policy + both Qs + alpha) with donated state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import _init_mlp, _mlp
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.buffer_size = 100_000
+        self.learning_starts = 1000
+        self.tau = 0.005                  # polyak target update rate
+        self.initial_alpha = 0.1
+        self.target_entropy: float | None = None   # default: -act_dim
+        # SAC wants ~1 gradient update per sampled transition — the
+        # classic off-policy ratio. 64-step sampling rounds with 64
+        # updates each keeps that ratio at the default batch size.
+        self.train_batch_size = 64
+        self.sgd_rounds_per_step = 64
+        self.update_batch_size = 256
+
+
+class SAC(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> SACConfig:
+        return SACConfig()
+
+    def setup(self) -> None:
+        cfg: SACConfig = self.config
+        env = self.workers.local.env
+        assert not env.action_space.discrete, "SAC is for continuous actions"
+        obs_dim = int(np.prod(env.observation_space.shape))
+        self.act_dim = int(np.prod(env.action_space.shape))
+        self.act_low = float(np.min(env.action_space.low))
+        self.act_high = float(np.max(env.action_space.high))
+        self.target_entropy = (cfg.target_entropy
+                               if cfg.target_entropy is not None
+                               else -float(self.act_dim))
+        k = jax.random.key(cfg.env_seed)
+        kpi, kq1, kq2 = jax.random.split(k, 3)
+        H = cfg.model_hiddens
+        self.params = {
+            # policy head outputs mean + log_std
+            "pi": _init_mlp(kpi, (obs_dim, *H, 2 * self.act_dim)),
+            "q1": _init_mlp(kq1, (obs_dim + self.act_dim, *H, 1),
+                            scale_last=1.0),
+            "q2": _init_mlp(kq2, (obs_dim + self.act_dim, *H, 1),
+                            scale_last=1.0),
+            "log_alpha": jnp.asarray(np.log(cfg.initial_alpha), jnp.float32),
+        }
+        self.target_q = {
+            "q1": jax.tree.map(jnp.copy, self.params["q1"]),
+            "q2": jax.tree.map(jnp.copy, self.params["q2"]),
+        }
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.env_seed)
+        self._key = jax.random.key(cfg.env_seed + 1)
+        self._act = jax.jit(self._act_impl)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1, 2))
+
+    # ---- policy distribution ----
+
+    def _pi(self, params, obs, key):
+        out = _mlp(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre_tanh = mean + std * eps                     # reparameterized
+        a = jnp.tanh(pre_tanh)
+        # log prob with tanh correction
+        logp = jnp.sum(
+            -0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            - jnp.log1p(-a**2 + 1e-6),
+            axis=-1)
+        scale = (self.act_high - self.act_low) / 2.0
+        mid = (self.act_high + self.act_low) / 2.0
+        return a * scale + mid, logp
+
+    def _act_impl(self, params, obs, key):
+        a, _ = self._pi(params, obs, key)
+        return a
+
+    def _q(self, qparams, obs, act):
+        return _mlp(qparams, jnp.concatenate([obs, act], axis=-1))[:, 0]
+
+    # ---- one fused update: Qs, policy, alpha ----
+
+    def _update_impl(self, params, opt_state, key, target_q, batch):
+        cfg: SACConfig = self.config
+        k1, k2 = jax.random.split(key)
+
+        def loss_fn(params):
+            alpha = jnp.exp(params["log_alpha"])
+            # target: r + γ(1-d)(min target-Q(s', a') − α log π(a'|s'))
+            a_next, logp_next = self._pi(params, batch[sb.NEXT_OBS], k1)
+            qt = jnp.minimum(
+                self._q(target_q["q1"], batch[sb.NEXT_OBS], a_next),
+                self._q(target_q["q2"], batch[sb.NEXT_OBS], a_next))
+            target = batch[sb.REWARDS] + cfg.gamma * (
+                1.0 - batch[sb.DONES].astype(jnp.float32)
+            ) * (qt - jax.lax.stop_gradient(alpha) * logp_next)
+            target = jax.lax.stop_gradient(target)
+            q1 = self._q(params["q1"], batch[sb.OBS], batch[sb.ACTIONS])
+            q2 = self._q(params["q2"], batch[sb.OBS], batch[sb.ACTIONS])
+            q_loss = jnp.mean((q1 - target) ** 2) + jnp.mean(
+                (q2 - target) ** 2)
+
+            a_new, logp_new = self._pi(params, batch[sb.OBS], k2)
+            q_new = jnp.minimum(
+                self._q(jax.lax.stop_gradient(params["q1"]),
+                        batch[sb.OBS], a_new),
+                self._q(jax.lax.stop_gradient(params["q2"]),
+                        batch[sb.OBS], a_new))
+            pi_loss = jnp.mean(
+                jax.lax.stop_gradient(alpha) * logp_new - q_new)
+
+            alpha_loss = -jnp.mean(
+                params["log_alpha"]
+                * jax.lax.stop_gradient(logp_new + self.target_entropy))
+            total = q_loss + pi_loss + alpha_loss
+            return total, (q_loss, pi_loss, alpha)
+
+        (total, (q_loss, pi_loss, alpha)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        target_q = jax.tree.map(
+            lambda t, o: (1 - cfg.tau) * t + cfg.tau * o,
+            target_q, {"q1": params["q1"], "q2": params["q2"]})
+        return params, opt_state, target_q, total, q_loss, pi_loss, alpha
+
+    # ---- sampling + training loop ----
+
+    def training_step(self) -> dict:
+        cfg: SACConfig = self.config
+        worker = self.workers.local
+        env = worker.env
+        obs = worker.obs
+        n_steps = max(1, cfg.train_batch_size // env.num_envs)
+        for _ in range(n_steps):
+            self._key, sub = jax.random.split(self._key)
+            if self._timesteps_total < cfg.learning_starts:
+                a = self._np_random_actions(env)
+            else:
+                a = np.asarray(self._act(
+                    self.params, jnp.asarray(obs, jnp.float32), sub))
+            next_obs, reward, done, trunc = env.step(a)
+            finished = np.logical_or(done, trunc)
+            stored_next = np.where(
+                finished.reshape((-1,) + (1,) * (next_obs.ndim - 1)),
+                env.final_obs, next_obs)
+            self.buffer.add(SampleBatch({
+                sb.OBS: obs.astype(np.float32),
+                sb.ACTIONS: np.asarray(a, np.float32).reshape(
+                    env.num_envs, self.act_dim),
+                sb.REWARDS: reward.astype(np.float32),
+                sb.DONES: done,
+                sb.NEXT_OBS: stored_next.astype(np.float32),
+            }))
+            worker._running_return += reward
+            for i in np.nonzero(finished)[0]:
+                worker.episode_returns.append(
+                    float(worker._running_return[i]))
+                worker._running_return[i] = 0.0
+            obs = next_obs
+            self._timesteps_total += env.num_envs
+        worker.obs = obs
+
+        metrics = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.sgd_rounds_per_step):
+                batch = self.buffer.sample(cfg.update_batch_size)
+                dev = {k: jnp.asarray(v) for k, v in batch.items()
+                       if k not in ("weights", "batch_indexes")}
+                self._key, sub = jax.random.split(self._key)
+                (self.params, self.opt_state, self.target_q, total,
+                 q_loss, pi_loss, alpha) = self._update(
+                    self.params, self.opt_state, sub, self.target_q, dev)
+            metrics = {
+                "total_loss": float(total), "q_loss": float(q_loss),
+                "pi_loss": float(pi_loss), "alpha": float(alpha),
+            }
+        m = worker.metrics()
+        return {
+            "timesteps_total": self._timesteps_total,
+            "episode_return_mean": m["episode_return_mean"],
+            **metrics,
+        }
+
+    def _np_random_actions(self, env):
+        rng = np.random.default_rng(int(self._timesteps_total) + 7)
+        return rng.uniform(self.act_low, self.act_high,
+                           (env.num_envs,) + tuple(
+                               env.action_space.shape or (1,)))
+
+
+SACConfig.algo_class = SAC

@@ -326,3 +326,32 @@ class TestActorPoolCompute:
         out = bp.predict(ds, compute=ActorPoolStrategy(1, 2))
         ys = sorted(r["y"] for r in out.take_all())
         assert ys == [3 * i for i in range(20)]
+
+
+class TestStatsAndSizeAwareRepartition:
+    def test_dataset_stats_surface(self, cluster):
+        ds = (ray_tpu.data.from_items([{"x": i} for i in range(100)])
+              .repartition(4)
+              .map_batches(lambda b: {"x": b["x"] * 2})
+              .materialize())
+        s = ds.stats()
+        assert "repartition" in s and "map_batches" in s, s
+        assert "blocks" in s
+
+    def test_target_block_size_repartition(self, cluster):
+        import numpy as np
+
+        # ~8 KB of int64 rows in 2 blocks -> target 1 KB blocks -> ~8 blocks
+        ds = ray_tpu.data.from_numpy(np.arange(1024)).repartition(2)
+        out = ds.repartition(
+            target_block_size_bytes=1024).materialize()
+        assert 6 <= len(out._block_refs) <= 10, len(out._block_refs)
+        vals = sorted(int(r["data"]) for r in out.take_all())
+        assert vals == list(range(1024))
+
+    def test_repartition_arg_validation(self, cluster):
+        ds = ray_tpu.data.from_items([1, 2, 3])
+        with pytest.raises(ValueError):
+            ds.repartition()
+        with pytest.raises(ValueError):
+            ds.repartition(4, target_block_size_bytes=100)

