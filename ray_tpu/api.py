@@ -172,7 +172,9 @@ def init(
             address = os.environ.get("RAY_TPU_ADDRESS")
         from ray_tpu.core.client import CoreClient
 
-        config = Config.from_env().override(_system_config)
+        from ray_tpu.core.config import current_config
+
+        config = current_config().override(_system_config)
         if address is not None and address.startswith("ray://"):
             # Remote driver: connect from outside the cluster; object data
             # travels over RPC instead of the same-host shm arena.
@@ -334,9 +336,23 @@ def _strategy_payload(o: dict):
                     "bundle_index": o.get("placement_group_bundle_index", -1)}
     if s is None or isinstance(s, str):
         return s
+    # PlacementGroupSchedulingStrategy-like object
+    if hasattr(s, "placement_group"):
+        from ray_tpu.core.placement_group import PlacementGroup
+
+        if isinstance(s.placement_group, PlacementGroup):
+            return {
+                "type": "placement_group",
+                "pg_id": s.placement_group.id.binary(),
+                "bundle_index": getattr(
+                    s, "placement_group_bundle_index", -1),
+            }
     # NodeAffinitySchedulingStrategy-like object
     if hasattr(s, "node_id"):
-        return {"type": "node_affinity", "node_id": s.node_id,
+        nid = s.node_id
+        if isinstance(nid, str):   # public node ids are hex (api.nodes())
+            nid = bytes.fromhex(nid)
+        return {"type": "node_affinity", "node_id": nid,
                 "soft": getattr(s, "soft", False)}
     return None
 

@@ -35,6 +35,10 @@ from ray_tpu.core.task_spec import (
 
 logger = logging.getLogger(__name__)
 
+# get_future() resolution for results that are not inline in the memory
+# store: the caller must fall back to a blocking get() off the loop.
+NEEDS_BLOCKING_GET = object()
+
 
 class GetTimeoutError(TimeoutError):
     pass
@@ -426,6 +430,53 @@ class CoreClient:
             serialization.write_to(view, head, views)
             view.release()
             await self.raylet.call("store_seal", {"object_id": oid})
+
+    def get_future(self, ref, timeout: float | None = None):
+        """Thread-free get for one ref produced by THIS client's tasks.
+
+        Returns a concurrent.futures.Future resolved on the client loop when
+        the creating task's reply lands — no waiter thread per in-flight
+        request (the async ingress path; ref: the reference proxy awaits
+        assignment results on its ASGI loop, serve/_private/http_proxy.py).
+        If the result is not inline in the memory store (plasma extent /
+        foreign object), the future resolves to NEEDS_BLOCKING_GET and the
+        caller must fall back to get() off-loop.
+        """
+        import concurrent.futures as _cf
+
+        out: _cf.Future = _cf.Future()
+        key = ref.id.binary()
+
+        async def _go():
+            try:
+                if key not in self._memory_store and key in self._result_events:
+                    # Atomic with _record_returns: both run on the client
+                    # loop, and there is no await between the check above
+                    # and arming the twin event.
+                    aev = self._return_ready.setdefault(key, asyncio.Event())
+                    if timeout is None:
+                        await aev.wait()
+                    else:
+                        await asyncio.wait_for(aev.wait(), timeout)
+                val = self._memory_store.get(key, NEEDS_BLOCKING_GET)
+                if isinstance(val, _TaskErrorSentinel):
+                    out.set_exception(val.err.to_exception())
+                    return
+                from ray_tpu.core.task_error import TaskError
+
+                if isinstance(val, TaskError):
+                    out.set_exception(val.to_exception())
+                    return
+                out.set_result(val)
+            except (asyncio.TimeoutError, TimeoutError):
+                out.set_exception(GetTimeoutError(
+                    f"task for object {ref.id.hex()[:16]} "
+                    "not finished in time"))
+            except Exception as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        asyncio.run_coroutine_threadsafe(_go(), self._loop)
+        return out
 
     def get(self, refs: Sequence, timeout: float | None = None) -> list:
         deadline = None if timeout is None else time.monotonic() + timeout

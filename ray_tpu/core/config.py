@@ -149,6 +149,40 @@ class Config:
     # fsync each WAL append (survives machine crash, not just process kill).
     gcs_wal_fsync: bool = False
 
+    # --- train gang rendezvous ---
+    # jax.distributed.initialize connection window for a worker gang.
+    train_rendezvous_timeout_s: float = 300.0
+    # XLA CPU-collective op timeout (--xla_cpu_collective_timeout_seconds;
+    # XLA's default 30s trips on compile skew between gang members when
+    # the host is loaded).
+    train_cpu_collective_timeout_s: float = 180.0
+
+    # --- serve control plane (ref: serve/_private/deployment_state.py +
+    #     gcs/gcs_server/gcs_health_check_manager.cc:1 — probes fail a
+    #     replica only after `failure_threshold` consecutive misses) ---
+    # Reconcile loop cadence.
+    serve_reconcile_interval_s: float = 0.5
+    # Per-probe health/stats RPC timeout.
+    serve_health_probe_timeout_s: float = 10.0
+    # Consecutive failed probes before a replica is considered dead. A
+    # single timed-out probe on a loaded box must not reap a healthy
+    # replica (definitive actor death still reaps immediately).
+    serve_health_failure_threshold: int = 3
+    # After a cold start from zero replicas, do not scale back below one
+    # replica for this long — the waking request needs time to land
+    # (handle-side demand is invisible to replica stats until then).
+    serve_cold_start_grace_s: float = 10.0
+    # HTTP ingress admission cap: in-flight requests beyond this get 503
+    # (bounded queueing; overload surfaces to clients).
+    serve_http_max_inflight: int = 1024
+    # Per-request end-to-end timeout at the ingress.
+    serve_http_request_timeout_s: float = 120.0
+    # Largest request body the ingress will buffer (413 beyond it).
+    serve_http_max_body_bytes: int = 64 * 1024**2
+    # Open-connection cap per ingress proxy (memory bound under overload:
+    # at most max_connections × max_body_bytes buffered).
+    serve_http_max_connections: int = 2048
+
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"
 
@@ -177,3 +211,35 @@ class Config:
 
 
 GLOBAL_CONFIG = Config.from_env()
+
+# Raylets forward their full (possibly _system_config-overridden) Config to
+# spawned workers through this env var, so driver-side overrides reach
+# library code running inside workers — not just RAY_TPU_* env vars.
+CONFIG_ENV_JSON = "RAY_TPU_CONFIG_JSON"
+
+
+def current_config() -> Config:
+    """Config for THIS process: the raylet-forwarded JSON in workers, the
+    environment otherwise."""
+    raw = os.environ.get(CONFIG_ENV_JSON)
+    if raw:
+        try:
+            return Config.from_json(raw)
+        except Exception:
+            pass
+    return Config.from_env()
+
+
+def runtime_config() -> Config:
+    """Best-effort config for library code that may run in any process:
+    the attached client's config when one exists (drivers, actors), else
+    `current_config()`. Never connects — reading a knob must not spawn a
+    cluster as a side effect. Never raises."""
+    try:
+        from ray_tpu import api as _api
+
+        if _api._client is not None:
+            return _api._client.config
+    except Exception:
+        pass
+    return current_config()

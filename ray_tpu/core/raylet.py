@@ -289,6 +289,11 @@ class Raylet:
         worker_id = WorkerID.from_random().binary()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = WorkerID(worker_id).hex()
+        # Forward the full config so driver _system_config overrides reach
+        # worker-side library code (config.current_config()).
+        from ray_tpu.core.config import CONFIG_ENV_JSON
+
+        env[CONFIG_ENV_JSON] = self.config.to_json()
         # Defer the sitecustomize's eager jax import + PJRT registration
         # (~2s of a ~2.1s worker boot): the worker re-arms it on first
         # `import jax` (utils/lazy_axon.py). jax-free workers boot ~15x

@@ -144,6 +144,10 @@ class Worker:
         self.raylet: rpc.Connection | None = None
         self.gcs: rpc.Connection | None = None
         self.actors: dict[bytes, ActorRuntime] = {}
+        # Actor ids whose ACTOR_CREATION is running in the executor, plus a
+        # per-actor arrival-order gate (see _h_push_task ordering note).
+        self._creating: set[bytes] = set()
+        self._actor_gates: dict[bytes, asyncio.Lock] = {}
         self.task_pool = _CancellableExecutor(1, thread_name_prefix="task")
         self.loop: asyncio.AbstractEventLoop | None = None
         self.address: tuple[str, int] | None = None
@@ -273,24 +277,48 @@ class Worker:
         spec: TaskSpec = p["spec"]
         _t0 = time.time()
         if spec.kind == ACTOR_TASK:
-            rt = self.actors.get(spec.actor_id)
-            if rt is None:
-                return {"status": "actor_missing"}
-            method = getattr(rt.instance, spec.method_name, None)
-            if asyncio.iscoroutinefunction(method):
+            # Per-actor FIFO gate: registration wait + executor submission
+            # happen in ARRIVAL order. Without it, a method push processed
+            # while the actor's __init__ is still running in the executor
+            # gets "actor_missing", and the client's retry lands AFTER later
+            # calls — breaking per-caller actor ordering (ref:
+            # direct_actor_task_submitter.cc sequenced send queue).
+            gate = self._actor_gates.setdefault(
+                spec.actor_id, asyncio.Lock())
+            fut = None
+            rt = None
+            async with gate:
+                rt = self.actors.get(spec.actor_id)
+                deadline = time.time() + 60.0
+                while (rt is None and spec.actor_id in self._creating
+                       and time.time() < deadline):
+                    await asyncio.sleep(0.02)
+                    rt = self.actors.get(spec.actor_id)
+                if rt is None:
+                    return {"status": "actor_missing"}
+                method = getattr(rt.instance, spec.method_name, None)
+                if not asyncio.iscoroutinefunction(method):
+                    fut = asyncio.get_running_loop().run_in_executor(
+                        rt.pool_for(method, spec), self._run_actor_task,
+                        rt, spec)
+            if fut is not None:
+                results, error = await fut
+            else:
                 # async actor: run on the actor's event loop, bounded by
                 # the concurrency semaphore (ref: core_worker/fiber.h).
                 results, error = await self._run_async_actor_task(rt, spec)
-            else:
+        elif spec.kind == ACTOR_CREATION:
+            # Mark BEFORE the executor runs __init__ (we are still in the
+            # synchronous prefix of this handler, so no method push for this
+            # actor can observe an intermediate state).
+            self._creating.add(spec.actor_id)
+            try:
                 fut = asyncio.get_running_loop().run_in_executor(
-                    rt.pool_for(method, spec), self._run_actor_task, rt, spec
+                    self.task_pool, self._run_actor_creation, spec
                 )
                 results, error = await fut
-        elif spec.kind == ACTOR_CREATION:
-            fut = asyncio.get_running_loop().run_in_executor(
-                self.task_pool, self._run_actor_creation, spec
-            )
-            results, error = await fut
+            finally:
+                self._creating.discard(spec.actor_id)
         else:
             fut = asyncio.get_running_loop().run_in_executor(
                 self.task_pool, self._run_normal_task, spec
@@ -598,7 +626,9 @@ def main() -> None:
                         format="[worker] %(levelname)s %(message)s")
     rhost, rport = args.raylet.rsplit(":", 1)
     ghost, gport = args.gcs.rsplit(":", 1)
-    config = Config.from_env()
+    from ray_tpu.core.config import current_config
+
+    config = current_config()
 
     async def run():
         worker = Worker(

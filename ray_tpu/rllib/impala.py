@@ -1,0 +1,212 @@
+"""IMPALA: importance-weighted async distributed RL (V-trace).
+
+Parity: `/root/reference/rllib/algorithms/impala/impala.py:1` (async
+sampling actors feeding a central learner through bounded in-flight sample
+requests) and `rllib/algorithms/impala/vtrace_tf.py` (V-trace off-policy
+correction). TPU-first design: the whole learner update — V-trace targets
+computed from CURRENT params plus the SGD step — is ONE jitted, donated
+device dispatch over a time-major [T, N] fragment; the async driver loop is
+pure object-plane plumbing (`wait` on sample refs, per-actor ordered weight
+pushes), so sampler throughput and learner throughput decouple exactly as
+in the reference.
+
+Backpressure: each rollout actor has at most
+`max_requests_in_flight_per_worker` outstanding sample fragments; the
+learner consumes one fragment per update, so samplers can never run more
+than the in-flight bound ahead of the learner.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+def vtrace(values, last_value, rhos, rewards, dones, truncs, boot, *,
+           gamma: float, clip_rho: float = 1.0, clip_pg_rho: float = 1.0):
+    """V-trace targets + policy-gradient advantages over [T, N] fragments.
+
+    values: V(x_t) under the TARGET policy's params, [T, N].
+    last_value: V(x_T) bootstrap, [N].
+    rhos: importance ratios pi(a|x)/mu(a|x), [T, N].
+    dones/truncs: episode boundaries; `boot` holds V(pre-reset terminal) at
+    truncated steps (the sampler's standard time-limit handling).
+
+    Returns (vs, pg_advantages), both [T, N]; no gradients flow (callers
+    stop-gradient the inputs).
+    """
+    rho_c = jnp.minimum(rhos, clip_rho)
+    cs = jnp.minimum(rhos, 1.0)
+    finished = jnp.logical_or(dones, truncs)
+    next_values = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    succ_v = jnp.where(dones, 0.0, jnp.where(truncs, boot, next_values))
+    deltas = rho_c * (rewards + gamma * succ_v - values)
+
+    def scan_fn(acc, xs):
+        delta, c, fin = xs
+        acc = delta + gamma * c * jnp.where(fin, 0.0, acc)
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(last_value), (deltas, cs, finished),
+        reverse=True)
+    vs = vs_minus_v + values
+    # q_t = r_t + gamma * vs_{t+1}; vs beyond a boundary = 0 (done) or the
+    # recorded pre-reset value (trunc); vs_T = V(x_T).
+    vs_next = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    vs_succ = jnp.where(dones, 0.0, jnp.where(truncs, boot, vs_next))
+    pg_adv = jnp.minimum(rhos, clip_pg_rho) * (
+        rewards + gamma * vs_succ - values)
+    return vs, pg_adv
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_rollout_workers = 2
+        self.lr = 5e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 40.0
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_pg_rho_threshold = 1.0
+        # Updates applied per train() iteration (each consumes one fragment).
+        self.num_updates_per_iter = 8
+        # Push fresh weights to a sampler every N of ITS fragments (1 = on
+        # every relaunch — the reference's default broadcast cadence).
+        self.broadcast_interval = 1
+        # Outstanding sample fragments per rollout actor (backpressure).
+        self.max_requests_in_flight_per_worker = 2
+
+
+class IMPALA(Algorithm):
+    """Async actors → central V-trace learner."""
+
+    @classmethod
+    def get_default_config(cls) -> IMPALAConfig:
+        return IMPALAConfig()
+
+    def setup(self) -> None:
+        cfg: IMPALAConfig = self.config
+        if not self.workers.remote_workers:
+            raise ValueError(
+                "IMPALA is the distributed async algorithm — set "
+                "num_rollout_workers >= 1 (use A2C/PPO for local mode)")
+        self.policy = self.workers.local.policy
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adam(cfg.lr),
+        )
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self._learn = jax.jit(self._update, donate_argnums=(0, 1))
+        # Async pipeline: prime every worker with fresh weights and
+        # max_requests_in_flight fragments.
+        w = self.policy.get_weights()
+        self._worker_updates: dict = {}
+        self._pending: dict = {}    # sample ref → worker
+        for worker in self.workers.remote_workers:
+            worker.set_weights.remote(w)
+            self._worker_updates[worker] = 0
+            for _ in range(cfg.max_requests_in_flight_per_worker):
+                self._pending[worker.sample.remote()] = worker
+
+    # ---- jitted learner update ----
+
+    def _loss(self, params, batch):
+        cfg: IMPALAConfig = self.config
+        pol = self.policy
+        T, N = batch[sb.REWARDS].shape
+        obs = batch[sb.OBS].reshape((T * N,) + batch[sb.OBS].shape[2:])
+        actions = batch[sb.ACTIONS].reshape(
+            (T * N,) + batch[sb.ACTIONS].shape[2:])
+        logp = pol._logp(params, obs, actions).reshape(T, N)
+        values = pol.value(params, obs).reshape(T, N)
+        last_v = pol.value(params, batch["last_obs"])
+        entropy = jnp.mean(pol._entropy(params, obs))
+        rhos = jnp.exp(logp - batch[sb.LOGP])
+        vs, pg_adv = vtrace(
+            jax.lax.stop_gradient(values), jax.lax.stop_gradient(last_v),
+            jax.lax.stop_gradient(rhos), batch[sb.REWARDS],
+            batch[sb.DONES], batch[sb.TRUNCS], batch[sb.BOOTSTRAP_VALUES],
+            gamma=cfg.gamma, clip_rho=cfg.vtrace_clip_rho_threshold,
+            clip_pg_rho=cfg.vtrace_clip_pg_rho_threshold)
+        pg_loss = -jnp.mean(logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        loss = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                - cfg.entropy_coeff * entropy)
+        mean_rho = jnp.mean(rhos)
+        return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                      "entropy": entropy, "mean_rho": mean_rho}
+
+    def _update(self, params, opt_state, batch):
+        (loss, info), grads = jax.value_and_grad(
+            self._loss, has_aux=True)(params, batch)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, info
+
+    # ---- async driver loop ----
+
+    def training_step(self) -> dict:
+        cfg: IMPALAConfig = self.config
+        losses, infos = [], []
+        for _ in range(cfg.num_updates_per_iter):
+            ready, _rest = ray_tpu.wait(
+                list(self._pending), num_returns=1, timeout=120)
+            if not ready:
+                raise TimeoutError("no sample fragment arrived within 120s")
+            ref = ready[0]
+            worker = self._pending.pop(ref)
+            try:
+                batch = ray_tpu.get(ref)
+            except Exception:
+                # Sampler died mid-fragment: drop it from the pipeline
+                # (lineage/actor restart policies handle revival).
+                self._worker_updates.pop(worker, None)
+                live = any(w in self._worker_updates
+                           for w in self._pending.values())
+                if not live:
+                    raise
+                continue
+            # Relaunch FIRST (actor-ordered after an optional weight push):
+            # the sampler fills the pipeline while the learner steps.
+            self._worker_updates[worker] = self._worker_updates.get(
+                worker, 0) + 1
+            if self._worker_updates[worker] >= cfg.broadcast_interval:
+                worker.set_weights.remote(self.policy.get_weights())
+                self._worker_updates[worker] = 0
+            self._pending[worker.sample.remote()] = worker
+
+            jb = {k: jnp.asarray(v) for k, v in batch.items()
+                  if k != "last_values"}
+            (self.policy.params, self.opt_state, loss,
+             info) = self._learn(self.policy.params, self.opt_state, jb)
+            losses.append(float(loss))
+            infos.append(info)
+            T, N = batch[sb.REWARDS].shape
+            self._timesteps_total += T * N
+        if not infos:
+            # Every slot this iteration hit a dying sampler; surviving
+            # samplers are still pipelined — report the stall, don't crash.
+            return {"total_loss": float("nan"), "updates_applied": 0}
+        agg = {k: float(np.mean([jax.device_get(i[k]) for i in infos]))
+               for k in infos[0]}
+        return {"total_loss": float(np.mean(losses)),
+                "updates_applied": len(losses), **agg}
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+        for worker in self.workers.remote_workers:
+            worker.set_weights.remote(weights)
+
+
+IMPALAConfig.algo_class = IMPALA

@@ -96,6 +96,9 @@ class RolloutWorker:
         _, _, last_vf = self.policy.compute_actions(self.obs, sub)
         batch = SampleBatch(cols)
         batch["last_values"] = last_vf
+        # Off-policy learners (IMPALA) recompute the bootstrap value with
+        # CURRENT params on the learner — ship the raw obs too.
+        batch["last_obs"] = self.obs.copy()
         return batch
 
     def metrics(self, window: int = 100) -> dict:

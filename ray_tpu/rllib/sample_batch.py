@@ -113,6 +113,7 @@ def collect_on_policy_batch(workers, *, gamma: float, lam: float,
     flat = []
     for b in batches:
         last_values = b.pop("last_values")
+        b.pop("last_obs", None)   # IMPALA-only bootstrap column, [N, ...]
         flat.append(flatten_time_major(
             compute_gae(b, last_values, gamma=gamma, lam=lam)))
     train_batch = SampleBatch.concat(flat)

@@ -12,11 +12,11 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
-from ray_tpu.serve.http_proxy import start_proxy
+from ray_tpu.serve.http_proxy import start_proxies, start_proxy
 from ray_tpu.serve.llm import LLMDeployment, LLMEngine
 
 __all__ = [
     "Deployment", "DeploymentHandle", "batch", "delete", "deployment",
     "get_deployment_handle", "run", "shutdown", "start", "status",
-    "start_proxy", "LLMDeployment", "LLMEngine",
+    "start_proxy", "start_proxies", "LLMDeployment", "LLMEngine",
 ]

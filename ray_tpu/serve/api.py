@@ -150,9 +150,14 @@ class DeploymentHandle:
         self.deployment_name = deployment_name
         self._version = -1
         self._replicas: list = []
-        self._rr = 0
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        # Router-local in-flight per replica (actor id → count): the
+        # power-of-two-choices signal, maintained from this handle's own
+        # dispatches instead of two blocking RPCs per request (ref: the
+        # reference router's RunningReplica queue-len cache,
+        # serve/_private/replica_scheduler/pow_2_scheduler.py).
+        self._local_inflight: dict[bytes, int] = {}
         try:
             _pushed_version()  # arm the process-level push subscription
         except Exception:
@@ -185,8 +190,6 @@ class DeploymentHandle:
     COLD_START_TIMEOUT_S = 60.0
 
     def _pick_replica(self):
-        import random
-
         replicas: list = []
         for attempt in range(4):
             with self._lock:
@@ -235,25 +238,70 @@ class DeploymentHandle:
             raise RuntimeError(
                 f"no replicas for deployment {self.deployment_name!r}"
             )
+        return self._p2c(replicas)
+
+    def _p2c(self, replicas: list):
+        """Power-of-two-choices on the handle's OWN outstanding counts — no
+        per-request RPC round trip."""
+        import random
+
         if len(replicas) == 1:
             return replicas[0]
-        # power-of-two-choices on in-flight counts
         a, b = random.sample(replicas, 2)
-        try:
-            la, lb = ray_tpu.get(
-                [a.num_inflight.remote(), b.num_inflight.remote()], timeout=10
-            )
-        except Exception:
-            self._refresh(force=True)
-            return random.choice(replicas)
+        with self._lock:
+            la = self._local_inflight.get(a._actor_id.binary(), 0)
+            lb = self._local_inflight.get(b._actor_id.binary(), 0)
         return a if la <= lb else b
+
+    def try_pick_replica(self):
+        """Non-blocking replica pick: a replica when the route cache is
+        fresh and has live replicas, else None (caller falls back to the
+        blocking _pick_replica off-loop). The async ingress fast path."""
+        with self._lock:
+            stale = (
+                self._version < _pushed_version()
+                or time.monotonic() - self._last_refresh > self.REFRESH_TTL_S
+            )
+            replicas = [] if stale else self._alive(self._replicas)
+        if not replicas:
+            return None
+        return self._p2c(replicas)
+
+    def _track(self, aid: bytes, ref) -> None:
+        """Count a dispatch against `aid` until its result ref resolves."""
+        from ray_tpu import api as _api
+
+        with self._lock:
+            self._local_inflight[aid] = self._local_inflight.get(aid, 0) + 1
+
+        def _done(_f):
+            with self._lock:
+                n = self._local_inflight.get(aid, 0)
+                if n <= 1:
+                    self._local_inflight.pop(aid, None)
+                else:
+                    self._local_inflight[aid] = n - 1
+
+        try:
+            _api._ensure_client().get_future(ref).add_done_callback(_done)
+        except Exception:
+            _done(None)
 
     def remote(self, *args, **kwargs):
         return self.method("__call__", *args, **kwargs)
 
+    def dispatch(self, replica, method_name: str, args: tuple,
+                 kwargs: dict):
+        """Submit one request to a chosen replica, tracked for the local
+        p2c in-flight signal. The single definition of the dispatch
+        envelope — handle.method/stream and the ingress proxy all route
+        through it."""
+        ref = replica.handle_request.remote(method_name, args, kwargs)
+        self._track(replica._actor_id.binary(), ref)
+        return ref
+
     def method(self, method_name: str, *args, **kwargs):
-        replica = self._pick_replica()
-        return replica.handle_request.remote(method_name, args, kwargs)
+        return self.dispatch(self._pick_replica(), method_name, args, kwargs)
 
     def stream(self, request: dict, *,
                submit_method: str = "submit_stream",
@@ -271,9 +319,13 @@ class DeploymentHandle:
         import ray_tpu
 
         replica = self._pick_replica()
-        sid = ray_tpu.get(
-            replica.handle_request.remote(submit_method, (request,), {}),
-            timeout=deadline_s)
+
+        def _call(method, *call_args):
+            # Tracked like method() dispatches: long token streams must
+            # weigh on the local p2c signal, not look like an idle replica.
+            return self.dispatch(replica, method, call_args, {})
+
+        sid = ray_tpu.get(_call(submit_method, request), timeout=deadline_s)
 
         def gen():
             import time as _time
@@ -282,8 +334,7 @@ class DeploymentHandle:
             t_end = _time.monotonic() + deadline_s
             while True:
                 out = ray_tpu.get(
-                    replica.handle_request.remote(
-                        poll_method, (sid, cursor, poll_timeout_s), {}),
+                    _call(poll_method, sid, cursor, poll_timeout_s),
                     timeout=60)
                 for tok in out["tokens"]:
                     yield tok

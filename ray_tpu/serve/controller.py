@@ -49,6 +49,14 @@ class ServeController:
         self._stop = False
         self._ckpt_seq = 0          # monotonic: drop out-of-order KV writes
         self._ckpt_write_lock = threading.Lock()
+        # actor_id → consecutive failed health probes. A replica is reaped
+        # only after `serve_health_failure_threshold` consecutive misses
+        # (ref: gcs_health_check_manager.cc failure_threshold) — a single
+        # timed-out probe on a loaded host must not kill a healthy replica.
+        self._health_fails: dict[str, int] = {}
+        from ray_tpu.core.config import runtime_config
+
+        self._cfg = runtime_config()
         self._restore()
         self._reconciler = threading.Thread(target=self._loop, daemon=True)
         self._reconciler.start()
@@ -73,6 +81,7 @@ class ServeController:
             d = {k: rec[k] for k in _CKPT_FIELDS}
             d["over_since"] = None
             d["under_since"] = None
+            d["cold_ts"] = None
             # Pickled (actor_id, handle) pairs: dead ones are filtered by
             # the first reconcile health probe; live ones are adopted as-is.
             d["replicas"] = rec["replicas"]
@@ -176,6 +185,7 @@ class ServeController:
                 # scale-up/-down threshold (None = not currently crossed)
                 "over_since": None,
                 "under_since": None,
+                "cold_ts": None,
                 "replicas": old["replicas"] if old else [],
                 "generation": (old["generation"] + 1) if old else 0,
             }
@@ -184,7 +194,7 @@ class ServeController:
                 self._drain_replicas(self.deployments[name], all=True)
             self._bump_version_locked()
             self._checkpoint_locked()
-        self._reconcile_once()
+        self._reconcile_once(only=name)
         return True
 
     def delete_deployment(self, name: str) -> bool:
@@ -218,13 +228,20 @@ class ServeController:
             d = self.deployments.get(name)
             if d is None:
                 return False
+            # Record the handle-side demand even if replicas already exist:
+            # during a cold start, replica stats can't see the queued
+            # request yet, and without this mark one idle reconcile tick
+            # would decay the fresh replica straight back to zero.
+            d["cold_ts"] = time.monotonic()
             if d["num_replicas"] < 1:
                 d["num_replicas"] = 1
                 d["under_since"] = None
                 d["over_since"] = None
             else:
                 return True
-        self._reconcile_once()
+        # Scoped: a wake-up must not wait behind probes of every other
+        # deployment's replicas.
+        self._reconcile_once(only=name)
         return True
 
     def is_member(self, deployment: str, actor_id_hex: str) -> bool:
@@ -289,12 +306,13 @@ class ServeController:
         d["replicas"] = [] if all else d["replicas"][:keep]
 
     def _loop(self):
+        interval = getattr(self._cfg, "serve_reconcile_interval_s", 0.5)
         while not self._stop:
             try:
                 self._reconcile_once()
             except Exception:
                 pass
-            time.sleep(0.5)
+            time.sleep(interval)
 
     def _autoscale_decision(self, d: dict, stats: list | None) -> None:
         """Queue-depth autoscaling (ref: autoscaling_policy.py
@@ -312,6 +330,24 @@ class ServeController:
         desired = max(ac["min_replicas"], min(desired, ac["max_replicas"]))
         now = time.monotonic()
         cur = d["num_replicas"]
+        if desired == 0 and cur > 0:
+            # Scale-TO-ZERO gates (beyond the sustained-undershoot timer):
+            # every replica must have been idle for the downscale delay —
+            # measured replica-side from its last completed request (a
+            # cold-started replica counts from construction, so the waking
+            # request can land before the first reap) — and a recent
+            # handle-side wake-up (cold_ts) pins at least one replica for
+            # the grace window.
+            grace = getattr(self._cfg, "serve_cold_start_grace_s", 10.0)
+            cold = d.get("cold_ts")
+            if cold is not None and now - cold < grace:
+                desired = 1
+            elif len(stats) < len(d["replicas"]) or any(
+                    s.get("idle_s", 1e9) < ac["downscale_delay_s"]
+                    for s in stats):
+                # Unprobed replicas (struck this tick) or recent activity:
+                # no evidence the deployment is truly idle.
+                desired = 1
         if desired > cur:
             d["under_since"] = None
             if d["over_since"] is None:
@@ -330,14 +366,18 @@ class ServeController:
             d["over_since"] = None
             d["under_since"] = None
 
-    def _reconcile_once(self):
+    def _reconcile_once(self, only: str | None = None):
         """Desired → actual: start missing replicas, reap dead ones
         (ref: deployment_state.py:958 reconcile loop).
 
         Blocking probes (health checks, load stats) run OUTSIDE the lock so
-        an unresponsive replica can't freeze get_routing/deploy; results are
-        applied under the lock only if the deployment generation is
-        unchanged."""
+        an unresponsive replica can't freeze get_routing/deploy, and they
+        run in PARALLEL under one shared deadline (submit all, then one
+        wait) — a wedged replica costs probe_timeout per tick, not per
+        replica. Results are applied under the lock only if the deployment
+        generation is unchanged, and only as targeted removals: replicas
+        added concurrently (request_scale_up) must not be clobbered by a
+        stale snapshot."""
         import ray_tpu
         from ray_tpu.serve.replica import Replica
 
@@ -346,29 +386,79 @@ class ServeController:
                 (name, d["generation"], list(d["replicas"]),
                  bool(d.get("autoscaling")))
                 for name, d in self.deployments.items()
+                if only is None or name == only
             ]
-        probed: dict[str, tuple[int, list, list | None]] = {}
+        from ray_tpu.exceptions import ActorDiedError
+
+        probe_timeout = getattr(self._cfg, "serve_health_probe_timeout_s", 10.0)
+        fail_limit = max(1, int(getattr(
+            self._cfg, "serve_health_failure_threshold", 3)))
+        probes = []     # (name, aid, ref, wants_stats)
         for name, gen, replicas, wants_stats in snapshot:
-            alive = []
-            stats: list | None = [] if wants_stats else None
             for aid, handle in replicas:
                 try:
+                    ref = (handle.stats.remote() if wants_stats
+                           else handle.health.remote())
+                except Exception:
+                    ref = None
+                probes.append((name, aid, ref, wants_stats))
+        ready_ids: set = set()
+        refs = [ref for (_n, _a, ref, _w) in probes if ref is not None]
+        if refs:
+            try:
+                ready, _pending = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=probe_timeout)
+                ready_ids = {r.id.binary() for r in ready}
+            except Exception:
+                pass
+        # (name, gen) → (drop_aids, stats)
+        probed: dict[str, tuple[int, set, list | None]] = {
+            name: (gen, set(), [] if wants_stats else None)
+            for name, gen, _r, wants_stats in snapshot
+        }
+        for name, aid, ref, wants_stats in probes:
+            gen, drop, stats = probed[name]
+            ok = False
+            if ref is not None and ref.id.binary() in ready_ids:
+                try:
+                    s = ray_tpu.get(ref, timeout=5)
+                    ok = True
                     if wants_stats:
-                        s = ray_tpu.get(handle.stats.remote(), timeout=10)
                         stats.append(s)
-                    else:
-                        ray_tpu.get(handle.health.remote(), timeout=10)
-                    alive.append((aid, handle))
+                except ActorDiedError:
+                    self._health_fails.pop(aid, None)  # definitively dead
+                    drop.add(aid)
+                    continue
                 except Exception:
                     pass
-            probed[name] = (gen, alive, stats)
+            if ok:
+                self._health_fails.pop(aid, None)
+            else:
+                # Timeout / transient: strike, but keep the replica in
+                # rotation until the consecutive-failure threshold — it
+                # contributes no stats this tick.
+                n = self._health_fails.get(aid, 0) + 1
+                self._health_fails[aid] = n
+                if n >= fail_limit:
+                    self._health_fails.pop(aid, None)
+                    drop.add(aid)
+        # Drop strike bookkeeping for replicas no longer tracked anywhere.
+        if only is None:
+            seen_aids = {aid for (_n, aid, _r, _w) in probes}
+            for aid in list(self._health_fails):
+                if aid not in seen_aids:
+                    del self._health_fails[aid]
         with self._lock:
-            for name, (gen, alive, stats) in probed.items():
+            for name, (gen, drop, stats) in probed.items():
                 d = self.deployments.get(name)
                 if d is None or d["generation"] != gen:
                     continue  # redeployed/deleted mid-probe
-                changed = len(alive) != len(d["replicas"])
-                d["replicas"] = alive
+                changed = bool(drop)
+                if drop:
+                    d["replicas"] = [
+                        (aid, h) for (aid, h) in d["replicas"]
+                        if aid not in drop
+                    ]
                 self._autoscale_decision(d, stats)
                 while len(d["replicas"]) > d["num_replicas"]:
                     self._drain_replicas(d, keep=d["num_replicas"])

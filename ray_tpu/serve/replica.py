@@ -8,6 +8,7 @@ applies reconfigure(user_config), and reports health.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 from ray_tpu.core import serialization
@@ -24,6 +25,10 @@ class Replica:
         self._inflight = 0
         self._lock = threading.Lock()
         self._processed = 0
+        # Idle clock for scale-to-zero: time since the last request
+        # FINISHED (or since construction) — a freshly cold-started replica
+        # reads as "busy" until the waking request has had its chance.
+        self._last_active = time.monotonic()
         if user_config is not None:
             self.reconfigure(user_config)
         if deployment_name is not None:
@@ -90,7 +95,12 @@ class Replica:
         return self._inflight
 
     def stats(self) -> dict:
-        return {"inflight": self._inflight, "processed": self._processed}
+        with self._lock:
+            idle = (0.0 if self._inflight > 0
+                    else time.monotonic() - self._last_active)
+            return {"inflight": self._inflight,
+                    "processed": self._processed,
+                    "idle_s": idle}
 
     def handle_request(self, method: str, args: tuple, kwargs: dict):
         with self._lock:
@@ -103,3 +113,4 @@ class Replica:
             with self._lock:
                 self._inflight -= 1
                 self._processed += 1
+                self._last_active = time.monotonic()

@@ -43,13 +43,20 @@ class TrainWorker:
             # XLA_FLAGS (e.g. the test harness forces 8 virtual devices).
             import re
 
+            from ray_tpu.core.config import runtime_config
+
             flags = os.environ.get("XLA_FLAGS", "")
             flags = re.sub(
                 r"--xla_force_host_platform_device_count=\d+", "", flags
             )
+            # XLA's CPU collectives default to a 30s op timeout — on a
+            # loaded box, compile skew between gang members can exceed it
+            # at the first allreduce (DEADLINE_EXCEEDED "rendezvous").
+            coll_t = int(runtime_config().train_cpu_collective_timeout_s)
             os.environ["XLA_FLAGS"] = (
                 flags
                 + f" --xla_force_host_platform_device_count={devices_per_worker}"
+                + f" --xla_cpu_collective_timeout_seconds={coll_t}"
             ).strip()
         import jax
 
@@ -63,9 +70,25 @@ class TrainWorker:
                     )
                 except Exception:
                     pass
+            from ray_tpu.core.config import runtime_config
+
             jax.distributed.initialize(
-                coordinator, num_processes=world_size, process_id=self.rank
+                coordinator, num_processes=world_size, process_id=self.rank,
+                initialization_timeout=int(
+                    runtime_config().train_rendezvous_timeout_s),
             )
+            # Establish the cross-process collective context NOW, while
+            # rank skew is only actor-boot skew: gloo's store-based
+            # full-mesh connect has a hard ~30s key wait that the
+            # collective-op timeout flag does not govern. Reaching the
+            # first real collective after a long (and cache-dependent)
+            # XLA compile can exceed it; a pre-compile barrier cannot.
+            try:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("gang_setup")
+            except Exception:
+                pass
         return {"rank": self.rank, "devices": len(jax.devices()),
                 "local_devices": len(jax.local_devices())}
 

@@ -235,9 +235,14 @@ class TestServeIntegration:
 
             # Warm: first generate compiles the prefill bucket + decode
             # step; timing assertions below must measure streaming, not XLA
-            # compile latency.
+            # compile latency. Warm the STREAM path too — it exercises the
+            # cursor-protocol RPCs and any stream-only engine code, which a
+            # plain generate does not.
             ray_tpu.get(handle.method(
                 "generate", [5, 9, 2], max_tokens=4), timeout=300)
+            for _ in handle.stream(
+                    {"prompt_ids": [5, 9, 2], "max_tokens": 3}):
+                pass
 
             # --- handle streaming: tokens arrive incrementally
             arrivals = []

@@ -347,3 +347,87 @@ class TestSAC:
                 break
         assert best > -600, f"SAC did not improve: best={best}"
         algo.stop()
+
+
+class TestIMPALA:
+    def test_vtrace_on_policy_matches_lambda1_gae(self):
+        """With behavior == target policy (rhos = 1), V-trace targets reduce
+        to the TD(lambda=1) returns — exactly compute_gae(lam=1.0)'s value
+        targets, including done/truncation boundary handling."""
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib import vtrace
+
+        rng = np.random.default_rng(3)
+        T, N = 12, 4
+        rewards = rng.normal(size=(T, N)).astype(np.float32)
+        values = rng.normal(size=(T, N)).astype(np.float32)
+        last_values = rng.normal(size=(N,)).astype(np.float32)
+        dones = rng.random((T, N)) < 0.15
+        truncs = np.logical_and(rng.random((T, N)) < 0.1, ~dones)
+        boot = np.where(truncs, rng.normal(size=(T, N)), 0.0).astype(np.float32)
+
+        batch = SampleBatch({
+            sb.REWARDS: rewards, sb.DONES: dones, sb.TRUNCS: truncs,
+            sb.VF_PREDS: values, sb.BOOTSTRAP_VALUES: boot,
+        })
+        gae = compute_gae(batch, last_values, gamma=0.97, lam=1.0)
+        vs, _pg = vtrace(
+            jnp.asarray(values), jnp.asarray(last_values),
+            jnp.ones((T, N), np.float32), jnp.asarray(rewards),
+            jnp.asarray(dones), jnp.asarray(truncs), jnp.asarray(boot),
+            gamma=0.97)
+        np.testing.assert_allclose(
+            np.asarray(vs), gae[sb.VALUE_TARGETS], rtol=1e-4, atol=1e-4)
+
+    def test_async_pipeline_machinery(self, cluster):
+        """Async driver contract: bounded in-flight fragments per sampler,
+        off-policy ratios near 1 at broadcast_interval=1, timesteps counted,
+        loss finite (fast CI tier)."""
+        from ray_tpu.rllib import IMPALAConfig
+
+        cfg = (IMPALAConfig()
+               .environment("CartPole-v1", seed=0)
+               .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                         rollout_fragment_length=32)
+               .training(num_updates_per_iter=4))
+        algo = cfg.build()
+        r1 = algo.train()
+        r2 = algo.train()
+        assert np.isfinite(r2["total_loss"])
+        # Each update consumes exactly one [32, 2] fragment.
+        assert r2["timesteps_total"] == 2 * 4 * 32 * 2
+        # Stale-by-one-fragment sampling: importance ratios stay near 1.
+        assert 0.5 < r2["mean_rho"] < 2.0, r2["mean_rho"]
+        # Backpressure invariant: in-flight never exceeds the per-worker cap.
+        assert len(algo._pending) == 2 * cfg.max_requests_in_flight_per_worker
+        algo.stop()
+
+    def test_impala_learns_cartpole(self, cluster):
+        """Distributed async learning end to end: 2 sampler actors feeding
+        the V-trace learner lift CartPole's return well above the ~20
+        random baseline (ref: rllib/algorithms/impala learning tests)."""
+        from ray_tpu.rllib import IMPALAConfig
+
+        cfg = (IMPALAConfig()
+               .environment("CartPole-v1", seed=0)
+               .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                         rollout_fragment_length=64)
+               .training(lr=5e-4, entropy_coeff=0.01,
+                         num_updates_per_iter=8))
+        algo = cfg.build()
+        first = None
+        result = None
+        best = -1e9
+        for _ in range(30):
+            result = algo.train()
+            mean = result["episode_return_mean"]
+            if first is None and mean is not None:
+                first = mean
+            if mean is not None:
+                best = max(best, mean)
+            if best > 100:
+                break
+        assert best > 100, (
+            f"IMPALA did not learn CartPole: first={first} best={best}")
+        algo.stop()

@@ -1,0 +1,61 @@
+"""Chip-recovery watcher: probes TPU backend init in a killable subprocess.
+
+The axon tunnel can wedge if a process is hard-killed mid-PJRT call
+(documented hazard); every later backend init then hangs. This watcher
+probes periodically (each probe is its own subprocess with a hard kill
+deadline — safe per the bench.py pattern) and appends one JSON line per
+probe to .chipwatch.jsonl. When a probe succeeds it writes .chip_ok and
+exits so a waiting bench run can proceed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, ".chipwatch.jsonl")
+OK = os.path.join(REPO, ".chip_ok")
+PROBE_TIMEOUT = float(os.environ.get("CHIP_PROBE_TIMEOUT", "120"))
+INTERVAL = float(os.environ.get("CHIP_PROBE_INTERVAL", "300"))
+MAX_HOURS = float(os.environ.get("CHIP_WATCH_MAX_HOURS", "11"))
+
+CODE = "import jax; d = jax.devices(); print(len(d), d[0].platform, d[0].device_kind)"
+
+
+def probe() -> tuple[bool, str]:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", CODE],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT,
+        )
+        if out.returncode == 0 and "tpu" in out.stdout.lower():
+            return True, out.stdout.strip()
+        return False, (out.stdout + out.stderr).strip()[-300:]
+    except subprocess.TimeoutExpired:
+        return False, f"hung >{PROBE_TIMEOUT}s (killed probe)"
+    except Exception as exc:  # noqa: BLE001
+        return False, repr(exc)
+
+
+def main() -> None:
+    start = time.time()
+    if os.path.exists(OK):
+        os.remove(OK)
+    while time.time() - start < MAX_HOURS * 3600:
+        t0 = time.time()
+        ok, detail = probe()
+        rec = {"t": round(time.time(), 1), "ok": ok, "detail": detail,
+               "probe_s": round(time.time() - t0, 1)}
+        with open(LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if ok:
+            with open(OK, "w") as f:
+                f.write(detail + "\n")
+            return
+        time.sleep(INTERVAL)
+
+
+if __name__ == "__main__":
+    main()
