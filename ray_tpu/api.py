@@ -231,12 +231,17 @@ def _pick_raylet(gcs_addr, config) -> tuple[str, int]:
 def shutdown() -> None:
     global _client, _node
     with _lock:
-        if _client is not None:
-            _client.shutdown()
-            _client = None
-        if _node is not None:
-            _node.stop()
-            _node = None
+        # Always clear the globals, even if teardown throws (e.g. the GCS
+        # was already killed by a fault-tolerance test) — a failed shutdown
+        # must not wedge every later init() with "already initialized".
+        client, _client = _client, None
+        node, _node = _node, None
+    try:
+        if client is not None:
+            client.shutdown()
+    finally:
+        if node is not None:
+            node.stop()
 
 
 # --------------------------------------------------------------- options

@@ -129,6 +129,7 @@ class CoreClient:
         self._worker_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._raylet_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._result_events: dict[bytes, threading.Event] = {}
+        self._bg_tasks: set = set()   # strong refs, see _spawn_bg
         # asyncio twins of _result_events, used for dependency resolution:
         # a task whose ref args are still being produced BY THIS CLIENT is
         # not enqueued until they land (ref: dependency_resolver.cc) — else
@@ -253,7 +254,7 @@ class CoreClient:
             # A borrower somewhere failed to pull an object we own: rebuild
             # it (lineage re-execution or owner re-put).
             if self.config.lineage_reconstruction_enabled and not self._closed:
-                asyncio.ensure_future(
+                self._ensure_bg(
                     self._recover_missing(payload["object_ids"]))
             return
         if method == "pub:actor":
@@ -279,6 +280,25 @@ class CoreClient:
     def _run(self, coro, timeout=None):
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         return fut.result(timeout)
+
+    # Background coroutines MUST be strongly referenced until done: asyncio
+    # tracks tasks weakly, and a pending task with no external reference
+    # can be garbage-collected mid-flight — its finally blocks run
+    # (GeneratorExit) but no result/failure is recorded, turning a dropped
+    # dispatch into a silent caller-side get() hang (observed ~1/600 under
+    # load). _spawn_bg marshals from any thread; _ensure_bg is loop-side.
+
+    def _spawn_bg(self, coro):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        self._bg_tasks.add(fut)
+        fut.add_done_callback(self._bg_tasks.discard)
+        return fut
+
+    def _ensure_bg(self, coro):
+        t = asyncio.ensure_future(coro)
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return t
 
     def shutdown(self) -> None:
         if self._closed:
@@ -353,7 +373,7 @@ class CoreClient:
                     pass
 
             try:
-                asyncio.run_coroutine_threadsafe(_unpin(), self._loop)
+                self._spawn_bg(_unpin())
             except RuntimeError:
                 pass
         return True
@@ -475,7 +495,7 @@ class CoreClient:
             except Exception as e:  # noqa: BLE001
                 out.set_exception(e)
 
-        asyncio.run_coroutine_threadsafe(_go(), self._loop)
+        self._spawn_bg(_go())
         return out
 
     def get(self, refs: Sequence, timeout: float | None = None) -> list:
@@ -880,8 +900,7 @@ class CoreClient:
                         self._lineage_deps[a.object_id] = (
                             self._lineage_deps.get(a.object_id, 0) + 1)
         refs = [ObjectRef(ObjectID(rid)) for rid in return_ids[:max(n, 1)]]
-        asyncio.run_coroutine_threadsafe(
-            self._drive_task(spec, escrow), self._loop)
+        self._spawn_bg(self._drive_task(spec, escrow))
         return refs if n != 1 else refs[:1]
 
     async def _lease_worker(self, spec: TaskSpec) -> tuple[dict, rpc.Connection]:
@@ -969,6 +988,14 @@ class CoreClient:
             ev.set()
             self._ensure_lanes(key)
             await pt.done
+        except Exception as e:  # noqa: BLE001 — see _drive_actor_task:
+            # a silently-dropped pipeline coroutine becomes a get() hang.
+            from ray_tpu.core.task_error import TaskError
+
+            logger.exception("task dispatch failed: %s", spec.name)
+            self._fail_returns(spec, TaskError(
+                "TaskUnschedulableError",
+                f"dispatch failed internally: {e!r}", ""))
         finally:
             if spec.return_ids:
                 self._task_index.pop(spec.return_ids[0], None)
@@ -1095,7 +1122,7 @@ class CoreClient:
         need = len(q) - self._idle_lanes.get(key, 0)
         while need > 0 and self._lanes.get(key, 0) < cap:
             self._lanes[key] = self._lanes.get(key, 0) + 1
-            asyncio.ensure_future(self._lease_lane(key))
+            self._ensure_bg(self._lease_lane(key))
             need -= 1
 
     async def _keepalive_wait(self, key: tuple) -> bool:
@@ -1257,9 +1284,8 @@ class CoreClient:
             if oid not in pending:
                 self.refcounter.decref(oid)
         if deferred:
-            asyncio.run_coroutine_threadsafe(
-                self._deferred_escrow_release(deferred, holder_id),
-                self._loop)
+            self._spawn_bg(
+                self._deferred_escrow_release(deferred, holder_id))
 
     async def _deferred_escrow_release(self, oids: list[bytes],
                                        holder_id: bytes) -> None:
@@ -1302,6 +1328,10 @@ class CoreClient:
             pass
 
     def _record_returns(self, spec: TaskSpec, reply: dict) -> None:
+        if os.environ.get("RAY_TPU_DEBUG_ACTOR_PUSH"):
+            logger.warning("record_returns %s n=%d",
+                           spec.return_ids[0].hex() if spec.return_ids
+                           else "?", len(reply.get("returns", [])))
         if reply.get("unflushed_acquires") and spec.return_ids:
             self._unflushed_replies[spec.return_ids[0]] = (
                 reply["ref_holder_id"], set(reply["unflushed_acquires"]))
@@ -1318,6 +1348,11 @@ class CoreClient:
 
     def _fail_returns(self, spec: TaskSpec, err) -> None:
         from ray_tpu.core.task_error import TaskError
+
+        if os.environ.get("RAY_TPU_DEBUG_ACTOR_PUSH"):
+            logger.warning("fail_returns %s err=%s",
+                           spec.return_ids[0].hex() if spec.return_ids
+                           else "?", getattr(err, "exc_type", err))
 
         if err is None:
             err = TaskError("UnknownError", "task failed", "")
@@ -1415,7 +1450,7 @@ class CoreClient:
                     self._actors[info["actor_id"]] = existing
                     return info["actor_id"]
             raise RuntimeError(reg.get("error", "actor registration failed"))
-        asyncio.ensure_future(self._place_actor(
+        self._ensure_bg(self._place_actor(
             st, spec, tuple(reg["node_address"]), reg["node_id"]
         ))
         return None
@@ -1546,13 +1581,13 @@ class CoreClient:
             "state": "queued", "canceled": False,
         }
         refs = [ObjectRef(ObjectID(rid)) for rid in return_ids[:max(n, 1)]]
-        asyncio.run_coroutine_threadsafe(
-            self._drive_actor_task(st, spec, escrow), self._loop
-        )
+        self._spawn_bg(self._drive_actor_task(st, spec, escrow))
         return refs if n != 1 else refs[:1]
 
     async def _drive_actor_task(self, st: ActorState, spec: TaskSpec,
                                 escrow: list[bytes] | None = None) -> None:
+        from ray_tpu.core.task_error import TaskError
+
         try:
             # NOTE: no _await_local_deps here — delaying dispatch on a
             # pending local dep would let later no-dep calls overtake this
@@ -1560,6 +1595,15 @@ class CoreClient:
             # worker-side; actor workers are dedicated, so that blocking
             # can't starve the shared task pool.
             await self._drive_actor_task_inner(st, spec)
+        except Exception as e:  # noqa: BLE001
+            # An unexpected dispatch failure must FAIL the returns, never
+            # vanish: this coroutine's exception goes nowhere (fire-and-
+            # forget future), and a silently-dropped task turns into a
+            # caller-side get() hang.
+            logger.exception("actor task dispatch failed: %s", spec.name)
+            self._fail_returns(spec, TaskError(
+                "ActorUnavailableError",
+                f"dispatch failed internally: {e!r}", ""))
         finally:
             if spec.return_ids:
                 self._task_index.pop(spec.return_ids[0], None)
@@ -1569,7 +1613,13 @@ class CoreClient:
                                       spec: TaskSpec) -> None:
         from ray_tpu.core.task_error import TaskError
 
+        _dbg = os.environ.get("RAY_TPU_DEBUG_ACTOR_PUSH")
         for attempt in range(100):
+            if _dbg and attempt > 0:
+                logger.warning("actor push %s attempt=%d addr=%s ready=%s",
+                               spec.return_ids[0].hex()[:16] if
+                               spec.return_ids else "?", attempt,
+                               st.address, st.ready.is_set())
             entry = (self._task_index.get(spec.return_ids[0])
                      if spec.return_ids else None)
             if isinstance(entry, dict) and entry.get("canceled"):
@@ -1600,7 +1650,7 @@ class CoreClient:
                     # If it's RESTARTING with no one driving placement (e.g.
                     # node died while idle), drive it ourselves.
                     if info is not None and info["state"] == "RESTARTING":
-                        asyncio.ensure_future(self._ensure_actor_restart(
+                        self._ensure_bg(self._ensure_actor_restart(
                             st, "observed RESTARTING"))
                     try:
                         await asyncio.wait_for(
@@ -1616,7 +1666,29 @@ class CoreClient:
             try:
                 conn = st.conn
                 if conn is None or conn.closed:
-                    conn = await self._worker_conn(st.address)
+                    try:
+                        conn = await self._worker_conn(st.address)
+                    except Exception as e:  # dial refused/timed out
+                        # The task was never sent — always safe to retry.
+                        # A booting worker's listener may not accept yet;
+                        # only after repeated refusals treat the address
+                        # as stale and re-resolve via the GCS.
+                        dial_fails = getattr(spec, "_dial_fails", 0) + 1
+                        spec._dial_fails = dial_fails
+                        # Patient: a booting worker's listener can lag its
+                        # published address by many seconds under load, and
+                        # a genuinely dead worker is reported through the
+                        # raylet death path anyway (st.dead short-circuits
+                        # this loop). ~30s of refusals before escalating.
+                        if dial_fails >= 120:
+                            spec._dial_fails = 0
+                            st.address = None
+                            st.conn = None
+                            st.ready.clear()
+                            self._ensure_bg(self._ensure_actor_restart(
+                                st, f"dial failed: {e!r}"))
+                        await asyncio.sleep(0.25)
+                        continue
                     st.conn = conn
                 spec.seq_no = next(st.seq)
                 entry = (self._task_index.get(spec.return_ids[0])
@@ -1645,7 +1717,7 @@ class CoreClient:
                 st.address = None
                 st.conn = None
                 st.ready.clear()
-                asyncio.ensure_future(self._ensure_actor_restart(st, str(e)))
+                self._ensure_bg(self._ensure_actor_restart(st, str(e)))
                 if spec.max_retries > 0:
                     spec.max_retries -= 1
                     continue
@@ -1740,9 +1812,8 @@ class CoreClient:
             st.address = None
             st.ready.clear()
             st.restarting = True
-            asyncio.run_coroutine_threadsafe(
-                self._ensure_actor_restart(st, "killed with no_restart=False"),
-                self._loop)
+            self._spawn_bg(self._ensure_actor_restart(
+                st, "killed with no_restart=False"))
         else:
             st.dead = True
             self._release_creation_escrow(st)

@@ -168,6 +168,10 @@ class Config:
     # single timed-out probe on a loaded box must not reap a healthy
     # replica (definitive actor death still reaps immediately).
     serve_health_failure_threshold: int = 3
+    # How long a STARTING replica may take to answer its first health
+    # probe before it is killed and replaced (ref: deployment_state.py
+    # STARTING → RUNNING transition; only RUNNING replicas are routable).
+    serve_replica_start_timeout_s: float = 180.0
     # After a cold start from zero replicas, do not scale back below one
     # replica for this long — the waking request needs time to land
     # (handle-side demand is invisible to replica stats until then).
@@ -185,6 +189,9 @@ class Config:
 
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"
+    # Machine-persistent root for built pip runtime envs ("" = under the
+    # session dir). Content-addressed digests make cross-session reuse safe.
+    pip_env_cache_dir: str = ""
 
     def override(self, overrides: dict[str, Any] | None) -> "Config":
         if not overrides:

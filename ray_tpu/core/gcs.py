@@ -22,6 +22,7 @@ from typing import Any
 from ray_tpu.core import rpc
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, NodeID
+from ray_tpu.utils.aio import spawn
 
 logger = logging.getLogger(__name__)
 
@@ -810,7 +811,7 @@ class GcsServer:
             if self.holder_conns.get(hid) is conn:
                 self._drop_holder(hid)
 
-        asyncio.ensure_future(cleanup())
+        spawn(cleanup())
 
     # ---------- failure detection ----------
 
@@ -838,7 +839,7 @@ class GcsServer:
         # Fail-over actors that lived there.
         for info_a in list(self.actors.values()):
             if info_a.node_id == node_id and info_a.state in (ALIVE, PENDING):
-                asyncio.ensure_future(
+                spawn(
                     self._actor_failed(None, {"actor_id": info_a.actor_id,
                                               "error": f"node died ({why})",
                                               "transition_only": True})
@@ -868,9 +869,9 @@ class GcsServer:
                 max(nd.version for nd in self.nodes.values()))
         self._wal_open()
         addr = await self.server.start()
-        asyncio.ensure_future(self._health_loop())
+        spawn(self._health_loop())
         if self.snapshot_path:
-            asyncio.ensure_future(self._snapshot_loop())
+            spawn(self._snapshot_loop())
         logger.info("GCS listening on %s", addr)
         return addr
 

@@ -28,6 +28,7 @@ from ray_tpu.core import rpc, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import NodeID, ObjectID, WorkerID
 from ray_tpu.core.object_store import LocalObjectStore
+from ray_tpu.utils.aio import spawn
 
 logger = logging.getLogger(__name__)
 
@@ -189,12 +190,12 @@ class Raylet:
         await self.gcs.call("subscribe", {"channels": ["node"]})
         view = await self.gcs.call("get_cluster_view", {})
         self.cluster_view = view
-        asyncio.ensure_future(self._heartbeat_loop())
-        asyncio.ensure_future(self._reap_idle_loop())
+        spawn(self._heartbeat_loop())
+        spawn(self._reap_idle_loop())
         if self.config.memory_monitor_period_s > 0:
-            asyncio.ensure_future(self._memory_monitor_loop())
+            spawn(self._memory_monitor_loop())
         if self.config.log_to_driver:
-            asyncio.ensure_future(self._log_monitor_loop())
+            spawn(self._log_monitor_loop())
         for _ in range(self.config.prestart_workers):
             self._spawn_worker()
         logger.info(
@@ -363,7 +364,7 @@ class Raylet:
             finally:
                 self._env_spawning.discard(env_key)
 
-        asyncio.ensure_future(build_and_spawn())
+        spawn(build_and_spawn())
 
     async def _h_register_worker(self, conn, p):
         worker_id = p["worker_id"]
@@ -930,7 +931,7 @@ class Raylet:
             except Exception as e:
                 logger.warning("location announce failed: %s", e)
 
-        asyncio.ensure_future(go())
+        spawn(go())
 
     async def _h_store_seal(self, conn, p):
         obj = ObjectID(p["object_id"])
@@ -1055,7 +1056,7 @@ class Raylet:
             # rather than parked doomed until disconnect.
             self._drop_conn_pins(conn, obj)
             self.store.free(obj)
-            asyncio.ensure_future(self.gcs.call("obj_loc_remove", {
+            spawn(self.gcs.call("obj_loc_remove", {
                 "object_id": ob, "node_id": self.node_id,
             }))
         return {"ok": True}

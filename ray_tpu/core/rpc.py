@@ -70,6 +70,9 @@ class Connection:
         ) = None
         self._closed = asyncio.Event()
         self._task: asyncio.Task | None = None
+        # In-flight request handlers need strong refs: asyncio tracks tasks
+        # weakly, and a GC'd pending handler never sends its reply.
+        self._handler_tasks: set = set()
         self.peername = writer.get_extra_info("peername")
 
     def start(self):
@@ -102,7 +105,10 @@ class Connection:
                         except Exception:
                             logger.exception("notify handler failed: %s", method)
                 elif kind == REQUEST:
-                    asyncio.ensure_future(self._serve_one(seq, method, payload))
+                    t = asyncio.ensure_future(
+                        self._serve_one(seq, method, payload))
+                    self._handler_tasks.add(t)
+                    t.add_done_callback(self._handler_tasks.discard)
         except (ConnectionLost, asyncio.CancelledError):
             pass
         except Exception:
@@ -177,6 +183,7 @@ class Server:
         self._handlers: dict[str, Callable[[Connection, Any], Awaitable[Any]]] = {}
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[Connection] = set()
+        self._reap_tasks: set = set()   # strong refs (weak task registry)
         self._on_disconnect: Callable[[Connection], None] | None = None
         # Request-id → result cache: a ReconnectingConnection retrying
         # through a redial cannot know whether its first attempt executed, so
@@ -234,7 +241,9 @@ class Server:
 
         conn._request_handler = dispatch
         conn.start()
-        asyncio.ensure_future(self._reap(conn))
+        t = asyncio.ensure_future(self._reap(conn))
+        self._reap_tasks.add(t)
+        t.add_done_callback(self._reap_tasks.discard)
 
     async def _reap(self, conn: Connection):
         await conn._closed.wait()

@@ -89,8 +89,20 @@ def resolve_runtime_env(env: dict | None, client) -> dict | None:
 PIP_CACHE_SIZE = int(os.environ.get("RAY_TPU_PIP_ENV_CACHE", "8"))
 
 
+def _pip_env_base(session_dir: str) -> str:
+    """Root for built pip venvs. Defaults under the session dir; the
+    `pip_env_cache_dir` knob relocates it to a machine-persistent path so
+    identical envs are reused ACROSS cluster sessions (venv builds cost
+    tens of seconds — content-addressed digests make cross-session reuse
+    safe)."""
+    from ray_tpu.core.config import runtime_config
+
+    override = runtime_config().pip_env_cache_dir
+    return override or os.path.join(session_dir, "runtime_envs", "pip")
+
+
 def pip_env_python(session_dir: str, digest: str) -> str:
-    return os.path.join(session_dir, "runtime_envs", "pip", digest,
+    return os.path.join(_pip_env_base(session_dir), digest,
                         "venv", "bin", "python")
 
 
@@ -107,7 +119,7 @@ def ensure_pip_env(pip_env: dict, session_dir: str, kv_get) -> str:
     import subprocess
     import time
 
-    base = os.path.join(session_dir, "runtime_envs", "pip")
+    base = _pip_env_base(session_dir)
     root = os.path.join(base, pip_env["digest"])
     ready = os.path.join(root, ".ready")
     py = pip_env_python(session_dir, pip_env["digest"])

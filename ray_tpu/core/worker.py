@@ -30,6 +30,7 @@ logger = logging.getLogger(__name__)
 
 
 from ray_tpu.core.task_error import TaskError
+from ray_tpu.utils.aio import spawn
 
 
 class _Cancelled(BaseException):
@@ -187,8 +188,8 @@ class Worker:
             logger.warning("raylet connection lost; exiting")
             os._exit(1)
 
-        asyncio.ensure_future(_watch_raylet())
-        asyncio.ensure_future(self._obs_flush_loop())
+        spawn(_watch_raylet())
+        spawn(self._obs_flush_loop())
         # Make this process usable as a client (nested tasks): api.init picks
         # these up lazily inside executing task code.
         os.environ["RAY_TPU_RAYLET_ADDRESS"] = (
@@ -289,9 +290,10 @@ class Worker:
             rt = None
             async with gate:
                 rt = self.actors.get(spec.actor_id)
-                deadline = time.time() + 60.0
-                while (rt is None and spec.actor_id in self._creating
-                       and time.time() < deadline):
+                # Wait as long as the creation is genuinely in flight (an
+                # LLM replica's __init__ can load weights for minutes);
+                # creation failure clears _creating and exits the loop.
+                while rt is None and spec.actor_id in self._creating:
                     await asyncio.sleep(0.02)
                     rt = self.actors.get(spec.actor_id)
                 if rt is None:

@@ -1,6 +1,7 @@
 """Data: distributed datasets on the object store (Ray Data parity)."""
 
 from ray_tpu.data.compute import ActorPoolStrategy
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.read_api import (
@@ -15,7 +16,7 @@ from ray_tpu.data.read_api import (
 )
 
 __all__ = [
-    "ActorPoolStrategy",
+    "ActorPoolStrategy", "DataContext",
     "Dataset", "DatasetPipeline", "Datasource", "GroupedData", "ReadTask",
     "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
     "read_csv", "read_datasource", "read_json", "read_parquet",

@@ -56,9 +56,13 @@ def run_actor_map(ctor_packed: bytes, refs: list,
                   strat: ActorPoolStrategy) -> list:
     """Map every block ref through an autoscaling actor pool.
 
-    Returns result refs in block order. The pool is torn down after all
-    blocks complete (this stage is a barrier, unlike task-compute stages —
-    same as the reference, where actor-pool stages break fusion).
+    Streaming ready-queue dispatch (ref: _internal/compute.py:88): result
+    refs return to the caller as soon as every block is DISPATCHED, not
+    completed — downstream task stages submit on those refs and start per
+    block as it lands, so stages overlap. Each wait round touches only the
+    outstanding window (≤ pool_size × max_tasks_in_flight refs), never the
+    whole block list — dispatch is O(blocks × window), not O(blocks²).
+    The pool is reaped by a monitor thread once all blocks complete.
     """
     if not refs:
         return []
@@ -75,43 +79,63 @@ def run_actor_map(ctor_packed: bytes, refs: list,
 
     actors = [spawn() for _ in range(strat.min_size)]
     counts = [0] * len(actors)
-    results: list = [None] * len(refs)
-    owner: dict[bytes, int] = {}   # result ref id → actor index
+    results: list = []
+    # result ref id → actor index, for the bounded in-flight window only.
+    outstanding: dict[bytes, tuple] = {}
 
-    def drain(block: bool) -> None:
-        outstanding = [r for r in results if r is not None
-                       and r.id.binary() in owner]
-        if not outstanding:
-            return
+    def reap_one() -> None:
         ready, _ = ray_tpu.wait(
-            outstanding, num_returns=1 if block else len(outstanding),
-            timeout=None if block else 0)
+            [r for (r, _j) in outstanding.values()],
+            num_returns=1, timeout=None)
         for r in ready:
-            j = owner.pop(r.id.binary(), None)
-            if j is not None:
-                counts[j] -= 1
+            _ref, j = outstanding.pop(r.id.binary())
+            counts[j] -= 1
 
-    for i, blk_ref in enumerate(refs):
-        drain(block=False)
+    for blk_ref in refs:
+        # Opportunistically drain finished work (non-blocking) so counts
+        # reflect reality before choosing an actor.
+        if outstanding:
+            done, _ = ray_tpu.wait(
+                [r for (r, _j) in outstanding.values()],
+                num_returns=len(outstanding), timeout=0)
+            for r in done:
+                _ref, j = outstanding.pop(r.id.binary())
+                counts[j] -= 1
         j = min(range(len(actors)), key=lambda k: counts[k])
         if counts[j] >= strat.max_tasks_in_flight and len(actors) < max_size:
             actors.append(spawn())
             counts.append(0)
             j = len(actors) - 1
         while counts[j] >= strat.max_tasks_in_flight:
-            drain(block=True)
+            reap_one()
             j = min(range(len(actors)), key=lambda k: counts[k])
         out = actors[j].apply.remote(blk_ref)
-        results[i] = out
-        owner[out.id.binary()] = j
+        results.append(out)
+        outstanding[out.id.binary()] = (out, j)
         counts[j] += 1
 
-    # Barrier: actors must outlive their queued work.
-    if results:
-        ray_tpu.wait(results, num_returns=len(results), timeout=None)
-    for a in actors:
+    # The reaper outlives this call (it may run after the driver shuts
+    # down) — pin it to THIS client: a bare ray_tpu.wait would lazily
+    # re-initialize a fresh cluster via _ensure_client after shutdown.
+    from ray_tpu import api as _api
+
+    client = _api._ensure_client()
+
+    def _reaper():
+        # Actors must outlive their queued work; blocks stream to
+        # consumers meanwhile.
         try:
-            ray_tpu.kill(a)
+            client.wait(results, len(results), None)
         except Exception:
             pass
+        for a in actors:
+            try:
+                client.kill_actor(a._actor_id.binary(), True)
+            except Exception:
+                pass
+
+    import threading
+
+    threading.Thread(target=_reaper, daemon=True,
+                     name="actor-pool-reaper").start()
     return results

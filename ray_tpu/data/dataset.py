@@ -72,6 +72,67 @@ def _map_block_task(fn_packed, blk):
     return fn(blk)
 
 
+@ray_tpu.remote(num_returns="dynamic")
+def _map_block_dynamic(fn_packed, target, blk):
+    """Fused map with dynamic block splitting: outputs above
+    `target` bytes are yielded as row-sliced sub-blocks, so a skewed or
+    expanding transform (flat_map) cannot hand downstream workers an
+    unboundedly large object (ref: data/context.py:29
+    target_max_block_size + dynamic generator returns)."""
+    from ray_tpu.core import serialization
+
+    fn = serialization.unpack(fn_packed)
+    out = fn(blk)
+    size = B.size_bytes(out)
+    n = B.num_rows(out)
+    if target and size > target and n > 1:
+        parts = min(n, -(-size // target))
+        step = -(-n // parts)
+        for s in range(0, n, step):
+            yield B.slice_block(out, s, min(s + step, n))
+    else:
+        yield out
+
+
+@ray_tpu.remote
+def _block_rows_task(blk):
+    return B.num_rows(blk)
+
+
+@ray_tpu.remote
+def _slice_block_task(blk, start, end):
+    return B.slice_block(blk, start, end)
+
+
+@ray_tpu.remote
+def _sample_block_task(fraction, seed, index, blk):
+    rng = np.random.default_rng(None if seed is None else seed + index)
+    n = B.num_rows(blk)
+    keep = np.nonzero(rng.random(n) < fraction)[0]
+    batch = B.to_batch(blk, "numpy")
+    if isinstance(batch, dict):
+        return B.from_batch({k: np.asarray(v)[keep] for k, v in batch.items()})
+    rows = B.to_rows(blk)
+    return B.build_block([rows[i] for i in keep])
+
+
+@ray_tpu.remote
+def _zip_block_task(blk, spans, *other_blks):
+    """Zip `blk` with the row-aligned slice of the other dataset, assembled
+    from `other_blks` pieces (spans[i] = (start, end) within other_blks[i])."""
+    pieces = [B.slice_block(o, s, e)
+              for o, (s, e) in zip(other_blks, spans)]
+    other = B.concat_blocks(pieces) if pieces else B.build_block([])
+    a = B.to_batch(blk, "numpy")
+    b = B.to_batch(other, "numpy")
+    if not (isinstance(a, dict) and isinstance(b, dict)):
+        raise TypeError("zip() requires tabular (dict-batch) datasets")
+    merged = dict(a)
+    for k, v in b.items():
+        merged[k + "_1" if k in merged else k] = v
+    return B.from_batch(merged)
+
+
 @ray_tpu.remote
 def _block_size_task(blk):
     return B.size_bytes(blk)
@@ -119,9 +180,24 @@ class Dataset:
                     names.append(self._stages[i].name)
                     i += 1
                 packed = serialization.pack(_fused_map(fns))
-                refs = [_map_block_task.remote(packed, r) for r in refs]
-                # Fused map stages are lazy tasks: charge their wall time
-                # when the blocks are consumed (here: submit latency only).
+                from ray_tpu.data.context import DataContext
+
+                ctx = DataContext.get_current()
+                target = (ctx.target_max_block_size
+                          if ctx.enable_dynamic_block_splitting else 0)
+                if target:
+                    # Dynamic block splitting: each task may yield several
+                    # sub-blocks; resolving the outer generator refs is a
+                    # stage barrier (the refs→item-refs indirection), the
+                    # price of bounding downstream block sizes.
+                    outer = [_map_block_dynamic.remote(packed, target, r)
+                             for r in refs]
+                    refs = [item for o in outer
+                            for item in ray_tpu.get(o, timeout=None)]
+                else:
+                    refs = [_map_block_task.remote(packed, r) for r in refs]
+                # Fused map stages without splitting are lazy tasks: charge
+                # their wall time when the blocks are consumed.
                 name = "+".join(names)
             elif isinstance(stage, ActorMapStage):
                 from ray_tpu.data.compute import run_actor_map
@@ -227,6 +303,90 @@ class Dataset:
             return B.build_block([r for r in B.to_rows(blk) if fn(r)])
 
         return self._with_stage(MapStage("filter", apply))
+
+    def add_column(self, name: str, fn: Callable[[Any], Any]) -> "Dataset":
+        """Append a column computed from each block's numpy batch
+        (ref: dataset.py add_column). Tabular datasets only."""
+
+        def apply(blk):
+            batch = B.to_batch(blk, "numpy")
+            if not isinstance(batch, dict):
+                raise TypeError("add_column() requires a tabular dataset")
+            out = dict(batch)
+            out[name] = np.asarray(fn(batch))
+            return B.from_batch(out)
+
+        return self._with_stage(MapStage(f"add_column({name})", apply))
+
+    def random_sample(self, fraction: float, *,
+                      seed: int | None = None) -> "Dataset":
+        """Keep each row independently with probability `fraction`
+        (ref: dataset.py random_sample). Per-block RNG streams derive from
+        (seed + block index), so a fixed seed is deterministic."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+
+        def do(refs):
+            return [_sample_block_task.remote(fraction, seed, i, r)
+                    for i, r in enumerate(refs)]
+
+        return self._with_stage(AllToAllStage("random_sample", do))
+
+    def limit(self, n: int) -> "Dataset":
+        """First `n` rows, preserving order; later blocks are dropped
+        without being consumed (ref: dataset.py limit)."""
+
+        def do(refs):
+            counts = ray_tpu.get(
+                [_block_rows_task.remote(r) for r in refs], timeout=600)
+            out, acc = [], 0
+            for r, c in zip(refs, counts):
+                if acc >= n:
+                    break
+                take = min(c, n - acc)
+                out.append(r if take == c
+                           else _slice_block_task.remote(r, 0, take))
+                acc += take
+            return out
+
+        return self._with_stage(AllToAllStage("limit", do))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two datasets with equal row counts
+        (ref: dataset.py zip). Rows pair up positionally; colliding column
+        names from `other` get a "_1" suffix. Each output block pulls only
+        the row-overlapping blocks of `other`."""
+
+        def do(refs):
+            other_refs = other._materialized_refs()
+            mine = ray_tpu.get(
+                [_block_rows_task.remote(r) for r in refs], timeout=600)
+            theirs = ray_tpu.get(
+                [_block_rows_task.remote(r) for r in other_refs],
+                timeout=600)
+            if sum(mine) != sum(theirs):
+                raise ValueError(
+                    f"zip() row counts differ: {sum(mine)} vs {sum(theirs)}")
+            # Prefix offsets of `other` blocks, for range alignment.
+            starts = list(itertools.accumulate([0] + theirs[:-1]))
+            out = []
+            lo = 0
+            for r, c in zip(refs, mine):
+                hi = lo + c
+                spans, pieces = [], []
+                for (o, os, oc) in zip(other_refs, starts, theirs):
+                    oe = os + oc
+                    if oe <= lo or os >= hi:
+                        continue
+                    s = max(lo, os) - os
+                    e = min(hi, oe) - os
+                    spans.append((s, e))
+                    pieces.append(o)
+                out.append(_zip_block_task.remote(r, spans, *pieces))
+                lo = hi
+            return out
+
+        return self._with_stage(AllToAllStage("zip", do))
 
     # ------------------------------------------------------------ all-to-all
 

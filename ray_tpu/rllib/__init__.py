@@ -17,6 +17,12 @@ from ray_tpu.rllib.env import (
     register_env,
 )
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentCartPole,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.policy import Policy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
@@ -27,7 +33,8 @@ from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 __all__ = [
     "A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig",
     "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
-    "vtrace",
+    "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
     "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",
     "Pendulum", "make_env", "register_env",

@@ -1,0 +1,307 @@
+"""Multi-agent environments + per-policy training.
+
+Parity: `/root/reference/rllib/env/multi_agent_env.py:1` (MultiAgentEnv
+dict contract), `rllib/policy/policy_map.py` (policy map +
+policy_mapping_fn), and the per-policy sample batching of
+`rllib/evaluation/sample_batch_builder.py`. MultiAgentPPO trains one
+independent PPO learner per policy id from a shared environment; each
+policy's update is the same jitted donated SGD epoch as single-agent PPO
+(ppo_core.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.env import CartPole, Space
+from ray_tpu.rllib.policy import Policy
+from ray_tpu.rllib.ppo import PPOConfig
+from ray_tpu.rllib.ppo_core import PPOHyperparams, make_sgd_epoch
+from ray_tpu.rllib.sample_batch import (
+    SampleBatch,
+    compute_gae,
+    flatten_time_major,
+)
+
+
+class MultiAgentEnv:
+    """Dict-keyed environment contract (ref: env/multi_agent_env.py).
+
+    reset() → {agent_id: obs}; step({agent_id: action}) →
+    (obs_dict, reward_dict, done_dict, trunc_dict). Sub-episodes auto-reset
+    (vector-training convention): a True in done/trunc marks the boundary
+    and the returned obs is already the fresh episode's first observation.
+    """
+
+    agent_ids: tuple = ()
+
+    def reset(self) -> dict:
+        raise NotImplementedError
+
+    def step(self, actions: dict) -> tuple[dict, dict, dict, dict]:
+        raise NotImplementedError
+
+    def observation_space(self, agent_id) -> Space:
+        raise NotImplementedError
+
+    def action_space(self, agent_id) -> Space:
+        raise NotImplementedError
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent CartPole sub-envs, one per agent — the reference's
+    standard multi-agent test env (rllib/examples/env/multi_agent.py
+    MultiAgentCartPole). Per-agent rewards/episodes are fully separate."""
+
+    def __init__(self, num_agents: int = 2, seed: int = 0):
+        self.agent_ids = tuple(f"agent_{i}" for i in range(num_agents))
+        self._envs = {
+            aid: CartPole(num_envs=1, seed=seed + 17 * i)
+            for i, aid in enumerate(self.agent_ids)
+        }
+        # agent → pre-reset terminal obs for agents truncated on the LAST
+        # step (time-limit bootstrap; cleared by each step()).
+        self.final_obs: dict = {}
+
+    def reset(self) -> dict:
+        return {aid: e.reset()[0] for aid, e in self._envs.items()}
+
+    def step(self, actions: dict):
+        obs, rew, done, trunc = {}, {}, {}, {}
+        self.final_obs = {}
+        for aid, e in self._envs.items():
+            o, r, d, t = e.step(np.asarray([actions[aid]]))
+            obs[aid] = o[0]
+            rew[aid] = float(r[0])
+            done[aid] = bool(d[0])
+            trunc[aid] = bool(t[0])
+            if t[0]:
+                # Pre-reset terminal observation, for time-limit value
+                # bootstrapping (same contract as VectorEnv.final_obs).
+                self.final_obs[aid] = e.final_obs[0]
+        return obs, rew, done, trunc
+
+    def observation_space(self, agent_id) -> Space:
+        return self._envs[agent_id].observation_space
+
+    def action_space(self, agent_id) -> Space:
+        return self._envs[agent_id].action_space
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.policies: tuple = ()          # policy ids
+        self.policy_mapping_fn: Callable[[Any], Any] | None = None
+
+    def multi_agent(self, *, policies, policy_mapping_fn
+                    ) -> "MultiAgentPPOConfig":
+        self.policies = tuple(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+
+class MultiAgentPPO:
+    """Per-policy PPO over a shared MultiAgentEnv.
+
+    Each step of the fragment, every agent acts with ITS policy (via
+    policy_mapping_fn); transitions group into per-policy time-major
+    batches (each mapped agent is one column), then each policy runs the
+    standard GAE + clipped-surrogate SGD epoch on its own batch.
+    """
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        cfg = config
+        if not cfg.policies or cfg.policy_mapping_fn is None:
+            raise ValueError(
+                "MultiAgentPPO needs .multi_agent(policies=...,"
+                " policy_mapping_fn=...)")
+        self.config = cfg
+        env = cfg.env
+        self.env: MultiAgentEnv = env() if callable(env) else env
+        self.iteration = 0
+        self._timesteps_total = 0
+        self.policy_map: dict[Any, Policy] = {}
+        self._opt = {}
+        self._opt_state = {}
+        self._sgd = {}
+        self._rng = np.random.default_rng(cfg.env_seed)
+        self.key = jax.random.key(cfg.env_seed)
+        # agent → policy assignment is fixed for the env's lifetime.
+        self.agent_policy = {
+            aid: cfg.policy_mapping_fn(aid) for aid in self.env.agent_ids
+        }
+        unknown = set(self.agent_policy.values()) - set(cfg.policies)
+        if unknown:
+            raise ValueError(f"policy_mapping_fn returned unknown {unknown}")
+        hp = PPOHyperparams(cfg.clip_param, cfg.vf_clip_param,
+                            cfg.vf_loss_coeff, cfg.entropy_coeff)
+        for i, pid in enumerate(cfg.policies):
+            agents = [a for a, p in self.agent_policy.items() if p == pid]
+            if not agents:
+                continue
+            pol = Policy(
+                self.env.observation_space(agents[0]),
+                self.env.action_space(agents[0]),
+                hiddens=tuple(cfg.model_hiddens), conv=cfg.model_conv,
+                seed=cfg.env_seed + 101 * i,
+            )
+            self.policy_map[pid] = pol
+            opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.adam(cfg.lr))
+            self._opt[pid] = opt
+            self._opt_state[pid] = opt.init(pol.params)
+            self._sgd[pid] = make_sgd_epoch(pol, opt, hp)
+        self._obs = self.env.reset()
+        self._running_return = {aid: 0.0 for aid in self.env.agent_ids}
+        self.episode_returns: dict[Any, list] = {
+            aid: [] for aid in self.env.agent_ids}
+
+    # ---------------------------------------------------------- sampling
+
+    def _sample_fragment(self) -> dict[Any, SampleBatch]:
+        """One [T, n_agents_of_policy] time-major fragment per policy."""
+        T = self.config.rollout_fragment_length
+        per_policy_agents = {
+            pid: [a for a, p in self.agent_policy.items() if p == pid]
+            for pid in self.policy_map
+        }
+        cols: dict[Any, dict] = {}
+        for pid, agents in per_policy_agents.items():
+            obs_space = self.env.observation_space(agents[0])
+            cols[pid] = {
+                sb.OBS: np.zeros((T, len(agents)) + obs_space.shape,
+                                 obs_space.dtype),
+                sb.ACTIONS: None,
+                sb.REWARDS: np.zeros((T, len(agents)), np.float32),
+                sb.DONES: np.zeros((T, len(agents)), bool),
+                sb.TRUNCS: np.zeros((T, len(agents)), bool),
+                sb.LOGP: np.zeros((T, len(agents)), np.float32),
+                sb.VF_PREDS: np.zeros((T, len(agents)), np.float32),
+                sb.BOOTSTRAP_VALUES: np.zeros((T, len(agents)), np.float32),
+            }
+        for t in range(T):
+            actions: dict = {}
+            for pid, agents in per_policy_agents.items():
+                pol = self.policy_map[pid]
+                stacked = np.stack([self._obs[a] for a in agents])
+                self.key, sub = jax.random.split(self.key)
+                act, logp, vf = pol.compute_actions(stacked, sub)
+                c = cols[pid]
+                c[sb.OBS][t] = stacked
+                if c[sb.ACTIONS] is None:
+                    c[sb.ACTIONS] = np.zeros((T,) + act.shape, act.dtype)
+                c[sb.ACTIONS][t] = act
+                c[sb.LOGP][t] = logp
+                c[sb.VF_PREDS][t] = vf
+                for j, a in enumerate(agents):
+                    actions[a] = act[j]
+            self._obs, rew, done, trunc = self.env.step(actions)
+            final_obs = getattr(self.env, "final_obs", {}) or {}
+            for pid, agents in per_policy_agents.items():
+                c = cols[pid]
+                for j, a in enumerate(agents):
+                    c[sb.REWARDS][t, j] = rew[a]
+                    c[sb.DONES][t, j] = done[a]
+                    c[sb.TRUNCS][t, j] = trunc[a]
+                # Time-limit truncation bootstraps through V(pre-reset
+                # terminal obs), matching the single-agent sampler
+                # (rollout_worker.py) — V=0 there would bias value targets
+                # low exactly on long, successful episodes.
+                trunc_agents = [(j, a) for j, a in enumerate(agents)
+                                if trunc[a] and a in final_obs]
+                if trunc_agents:
+                    pol = self.policy_map[pid]
+                    stacked_f = np.stack([final_obs[a]
+                                          for _j, a in trunc_agents])
+                    self.key, sub = jax.random.split(self.key)
+                    _, _, vf_fin = pol.compute_actions(stacked_f, sub)
+                    for (j, _a), v in zip(trunc_agents, vf_fin):
+                        c[sb.BOOTSTRAP_VALUES][t, j] = v
+            for a in self.env.agent_ids:
+                self._running_return[a] += rew[a]
+                if done[a] or trunc[a]:
+                    self.episode_returns[a].append(self._running_return[a])
+                    self._running_return[a] = 0.0
+        out = {}
+        for pid, agents in per_policy_agents.items():
+            pol = self.policy_map[pid]
+            stacked = np.stack([self._obs[a] for a in agents])
+            self.key, sub = jax.random.split(self.key)
+            _, _, last_vf = pol.compute_actions(stacked, sub)
+            batch = SampleBatch(cols[pid])
+            batch["last_values"] = last_vf
+            out[pid] = batch
+        return out
+
+    # ---------------------------------------------------------- training
+
+    def train(self) -> dict:
+        cfg = self.config
+        t0 = time.perf_counter()
+        per_policy = self._sample_fragment()
+        info: dict = {}
+        for pid, batch in per_policy.items():
+            last_values = batch.pop("last_values")
+            train_batch = flatten_time_major(compute_gae(
+                batch, last_values, gamma=cfg.gamma, lam=cfg.lambda_))
+            adv = train_batch[sb.ADVANTAGES]
+            train_batch[sb.ADVANTAGES] = (
+                (adv - adv.mean()) / max(1e-8, adv.std())).astype(np.float32)
+            self._timesteps_total += train_batch.count
+            mb = min(cfg.sgd_minibatch_size, train_batch.count)
+            n_mb = max(1, train_batch.count // mb)
+            pol = self.policy_map[pid]
+            losses = None
+            for _ in range(cfg.num_sgd_iter):
+                shuffled = train_batch.shuffle(self._rng)
+                stacked = {
+                    k: jnp.asarray(
+                        v[: n_mb * mb].reshape((n_mb, mb) + v.shape[1:]))
+                    for k, v in shuffled.items()
+                }
+                pol.params, self._opt_state[pid], losses, _infos = (
+                    self._sgd[pid](pol.params, self._opt_state[pid], stacked))
+            info[f"{pid}/total_loss"] = float(jnp.mean(losses))
+        self.iteration += 1
+        returns = {}
+        for pid in self.policy_map:
+            agents = [a for a, p in self.agent_policy.items() if p == pid]
+            vals = [r for a in agents for r in self.episode_returns[a][-20:]]
+            returns[pid] = float(np.mean(vals)) if vals else None
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "policy_reward_mean": returns,
+            "episode_return_mean": (
+                float(np.mean([v for v in returns.values()
+                               if v is not None]))
+                if any(v is not None for v in returns.values()) else None),
+            "time_this_iter_s": time.perf_counter() - t0,
+            **info,
+        }
+
+    def get_weights(self) -> dict:
+        return {pid: p.get_weights() for pid, p in self.policy_map.items()}
+
+    def set_weights(self, weights: dict) -> None:
+        for pid, w in weights.items():
+            self.policy_map[pid].set_weights(w)
+
+    def stop(self) -> None:
+        pass
+
+
+__all__ = [
+    "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+]
+
+MultiAgentPPOConfig.algo_class = MultiAgentPPO

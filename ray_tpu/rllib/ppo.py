@@ -8,9 +8,6 @@ so an iteration is a single device dispatch regardless of minibatch count.
 
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -39,6 +36,8 @@ class PPO(Algorithm):
         return PPOConfig()
 
     def setup(self) -> None:
+        from ray_tpu.rllib.ppo_core import PPOHyperparams, make_sgd_epoch
+
         cfg: PPOConfig = self.config
         self.policy = self.workers.local.policy
         self.optimizer = optax.chain(
@@ -47,47 +46,10 @@ class PPO(Algorithm):
         )
         self.opt_state = self.optimizer.init(self.policy.params)
         self._rng = np.random.default_rng(cfg.env_seed)
-        self._sgd_step = jax.jit(self._sgd_epoch, donate_argnums=(0, 1))
-
-    # ---- loss ----
-
-    def _loss(self, params, batch):
-        cfg: PPOConfig = self.config
-        pol = self.policy
-        logp = pol._logp(params, batch[sb.OBS], batch[sb.ACTIONS])
-        ratio = jnp.exp(logp - batch[sb.LOGP])
-        adv = batch[sb.ADVANTAGES]
-        surr = jnp.minimum(
-            ratio * adv,
-            jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param) * adv,
-        )
-        vf = pol.value(params, batch[sb.OBS])
-        vf_err = jnp.clip(
-            vf - batch[sb.VALUE_TARGETS], -cfg.vf_clip_param, cfg.vf_clip_param
-        )
-        vf_loss = jnp.mean(vf_err**2)
-        entropy = jnp.mean(pol._entropy(params, batch[sb.OBS]))
-        loss = (-jnp.mean(surr) + cfg.vf_loss_coeff * vf_loss
-                - cfg.entropy_coeff * entropy)
-        return loss, {"policy_loss": -jnp.mean(surr), "vf_loss": vf_loss,
-                      "entropy": entropy}
-
-    def _sgd_epoch(self, params, opt_state, minibatches):
-        """minibatches: pytree of [n_mb, mb_size, ...] arrays; one scan over
-        minibatches = one device dispatch per epoch."""
-
-        def step(carry, mb):
-            params, opt_state = carry
-            (loss, info), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, mb)
-            updates, opt_state = self.optimizer.update(
-                grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state), (loss, info)
-
-        (params, opt_state), (losses, infos) = jax.lax.scan(
-            step, (params, opt_state), minibatches)
-        return params, opt_state, losses, infos
+        self._sgd_step = make_sgd_epoch(
+            self.policy, self.optimizer,
+            PPOHyperparams(cfg.clip_param, cfg.vf_clip_param,
+                           cfg.vf_loss_coeff, cfg.entropy_coeff))
 
     # ---- training step ----
 

@@ -1,0 +1,64 @@
+"""PPO loss + jitted SGD epoch, shared by single- and multi-agent PPO.
+
+Parity: `/root/reference/rllib/algorithms/ppo/ppo_torch_policy.py` loss
+terms (clipped surrogate, vf clipping, entropy bonus). Factored out of
+ppo.py so MultiAgentPPO trains each policy with exactly the same math.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+
+
+@dataclass(frozen=True)
+class PPOHyperparams:
+    clip_param: float = 0.2
+    vf_clip_param: float = 10.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.0
+
+
+def ppo_loss(policy, hp: PPOHyperparams, params, batch):
+    logp = policy._logp(params, batch[sb.OBS], batch[sb.ACTIONS])
+    ratio = jnp.exp(logp - batch[sb.LOGP])
+    adv = batch[sb.ADVANTAGES]
+    surr = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1 - hp.clip_param, 1 + hp.clip_param) * adv,
+    )
+    vf = policy.value(params, batch[sb.OBS])
+    vf_err = jnp.clip(
+        vf - batch[sb.VALUE_TARGETS], -hp.vf_clip_param, hp.vf_clip_param
+    )
+    vf_loss = jnp.mean(vf_err**2)
+    entropy = jnp.mean(policy._entropy(params, batch[sb.OBS]))
+    loss = (-jnp.mean(surr) + hp.vf_loss_coeff * vf_loss
+            - hp.entropy_coeff * entropy)
+    return loss, {"policy_loss": -jnp.mean(surr), "vf_loss": vf_loss,
+                  "entropy": entropy}
+
+
+def make_sgd_epoch(policy, optimizer, hp: PPOHyperparams):
+    """Jitted epoch: scan over stacked minibatches [n_mb, mb, ...] with
+    donated params/opt_state — one device dispatch per epoch."""
+
+    def epoch(params, opt_state, minibatches):
+        def step(carry, mb):
+            params, opt_state = carry
+            (loss, info), grads = jax.value_and_grad(
+                ppo_loss, argnums=2, has_aux=True)(policy, hp, params, mb)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), (loss, info)
+
+        (params, opt_state), (losses, infos) = jax.lax.scan(
+            step, (params, opt_state), minibatches)
+        return params, opt_state, losses, infos
+
+    return jax.jit(epoch, donate_argnums=(0, 1))

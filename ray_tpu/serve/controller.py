@@ -49,11 +49,15 @@ class ServeController:
         self._stop = False
         self._ckpt_seq = 0          # monotonic: drop out-of-order KV writes
         self._ckpt_write_lock = threading.Lock()
-        # actor_id → consecutive failed health probes. A replica is reaped
-        # only after `serve_health_failure_threshold` consecutive misses
-        # (ref: gcs_health_check_manager.cc failure_threshold) — a single
-        # timed-out probe on a loaded host must not kill a healthy replica.
-        self._health_fails: dict[str, int] = {}
+        # actor_id → (consecutive failed probes, last-strike monotonic).
+        # A replica is reaped only after `serve_health_failure_threshold`
+        # consecutive misses (ref: gcs_health_check_manager.cc
+        # failure_threshold) — a single timed-out probe on a loaded host
+        # must not kill a healthy replica. The timestamp rate-limits
+        # strikes to one per probe window: reconciles can overlap (the
+        # background loop plus deploy/request_scale_up-scoped ones), and
+        # double-counting one wedged window would defeat the threshold.
+        self._health_fails: dict[str, tuple[int, float]] = {}
         from ray_tpu.core.config import runtime_config
 
         self._cfg = runtime_config()
@@ -82,6 +86,7 @@ class ServeController:
             d["over_since"] = None
             d["under_since"] = None
             d["cold_ts"] = None
+            d["starting"] = []
             # Pickled (actor_id, handle) pairs: dead ones are filtered by
             # the first reconcile health probe; live ones are adopted as-is.
             d["replicas"] = rec["replicas"]
@@ -105,7 +110,9 @@ class ServeController:
             "version": self.version,
             "deployments": {
                 name: {**{k: d[k] for k in _CKPT_FIELDS},
-                       "replicas": list(d["replicas"])}
+                       "replicas": (list(d["replicas"])
+                                    + [(a, h) for (a, h, _t)
+                                       in d.get("starting", [])])}
                 for name, d in self.deployments.items()
             },
         }
@@ -187,6 +194,11 @@ class ServeController:
                 "under_since": None,
                 "cold_ts": None,
                 "replicas": old["replicas"] if old else [],
+                # Spawned but not yet past their first health probe —
+                # NOT in the routing table (ref: deployment_state.py
+                # STARTING → RUNNING; routing a still-booting replica
+                # makes requests wait out the whole actor boot).
+                "starting": old.get("starting", []) if old else [],
                 "generation": (old["generation"] + 1) if old else 0,
             }
             if old:
@@ -250,7 +262,9 @@ class ServeController:
             d = self.deployments.get(deployment)
             if d is None:
                 return False
-            return any(aid == actor_id_hex for aid, _h in d["replicas"])
+            return (any(aid == actor_id_hex for aid, _h in d["replicas"])
+                    or any(aid == actor_id_hex
+                           for aid, _h, _t in d.get("starting", [])))
 
     def list_deployments(self) -> dict:
         with self._lock:
@@ -258,6 +272,7 @@ class ServeController:
                 name: {
                     "num_replicas": d["num_replicas"],
                     "live_replicas": len(d["replicas"]),
+                    "starting_replicas": len(d.get("starting", [])),
                     "route_prefix": d["route_prefix"],
                     "autoscaling": d.get("autoscaling"),
                 }
@@ -297,7 +312,10 @@ class ServeController:
     def _drain_replicas(self, d: dict, all: bool = False, keep: int = 0):
         import ray_tpu
 
-        victims = d["replicas"] if all else d["replicas"][keep:]
+        victims = list(d["replicas"] if all else d["replicas"][keep:])
+        if all:
+            victims += [(a, h) for (a, h, _t) in d.get("starting", [])]
+            d["starting"] = []
         for _aid, handle in victims:
             try:
                 ray_tpu.kill(handle)
@@ -342,11 +360,11 @@ class ServeController:
             cold = d.get("cold_ts")
             if cold is not None and now - cold < grace:
                 desired = 1
-            elif len(stats) < len(d["replicas"]) or any(
-                    s.get("idle_s", 1e9) < ac["downscale_delay_s"]
-                    for s in stats):
-                # Unprobed replicas (struck this tick) or recent activity:
-                # no evidence the deployment is truly idle.
+            elif (d.get("starting") or len(stats) < len(d["replicas"])
+                  or any(s.get("idle_s", 1e9) < ac["downscale_delay_s"]
+                         for s in stats)):
+                # Booting capacity, unprobed replicas (struck this tick),
+                # or recent activity: no evidence the deployment is idle.
                 desired = 1
         if desired > cur:
             d["under_since"] = None
@@ -384,7 +402,7 @@ class ServeController:
         with self._lock:
             snapshot = [
                 (name, d["generation"], list(d["replicas"]),
-                 bool(d.get("autoscaling")))
+                 list(d.get("starting", [])), bool(d.get("autoscaling")))
                 for name, d in self.deployments.items()
                 if only is None or name == only
             ]
@@ -393,17 +411,23 @@ class ServeController:
         probe_timeout = getattr(self._cfg, "serve_health_probe_timeout_s", 10.0)
         fail_limit = max(1, int(getattr(
             self._cfg, "serve_health_failure_threshold", 3)))
-        probes = []     # (name, aid, ref, wants_stats)
-        for name, gen, replicas, wants_stats in snapshot:
+        probes = []     # (name, aid, ref, wants_stats, is_starting)
+        for name, gen, replicas, starting, wants_stats in snapshot:
             for aid, handle in replicas:
                 try:
                     ref = (handle.stats.remote() if wants_stats
                            else handle.health.remote())
                 except Exception:
                     ref = None
-                probes.append((name, aid, ref, wants_stats))
+                probes.append((name, aid, ref, wants_stats, False))
+            for aid, handle, _spawned in starting:
+                try:
+                    ref = handle.health.remote()
+                except Exception:
+                    ref = None
+                probes.append((name, aid, ref, False, True))
         ready_ids: set = set()
-        refs = [ref for (_n, _a, ref, _w) in probes if ref is not None]
+        refs = [ref for (_n, _a, ref, _w, _s) in probes if ref is not None]
         if refs:
             try:
                 ready, _pending = ray_tpu.wait(
@@ -411,14 +435,15 @@ class ServeController:
                 ready_ids = {r.id.binary() for r in ready}
             except Exception:
                 pass
-        # (name, gen) → (drop_aids, stats)
-        probed: dict[str, tuple[int, set, list | None]] = {
-            name: (gen, set(), [] if wants_stats else None)
-            for name, gen, _r, wants_stats in snapshot
+        # name → (gen, drop_serving, promote, drop_starting, stats)
+        probed: dict[str, tuple] = {
+            name: (gen, set(), set(), set(), [] if wants_stats else None)
+            for name, gen, _r, _st, wants_stats in snapshot
         }
-        for name, aid, ref, wants_stats in probes:
-            gen, drop, stats = probed[name]
+        for name, aid, ref, wants_stats, is_starting in probes:
+            gen, drop, promote, drop_start, stats = probed[name]
             ok = False
+            died = False
             if ref is not None and ref.id.binary() in ready_ids:
                 try:
                     s = ray_tpu.get(ref, timeout=5)
@@ -426,44 +451,92 @@ class ServeController:
                     if wants_stats:
                         stats.append(s)
                 except ActorDiedError:
-                    self._health_fails.pop(aid, None)  # definitively dead
-                    drop.add(aid)
-                    continue
+                    died = True
                 except Exception:
                     pass
-            if ok:
+            if is_starting:
+                # STARTING replicas: no strikes — unready is their normal
+                # state. Ready → promote into the routing table; dead →
+                # drop (the capacity loop respawns); else keep waiting
+                # (the start timeout is enforced under the lock below).
+                if ok:
+                    promote.add(aid)
+                elif died:
+                    drop_start.add(aid)
+                continue
+            if died:
+                self._health_fails.pop(aid, None)  # definitively dead
+                drop.add(aid)
+            elif ok:
                 self._health_fails.pop(aid, None)
             else:
                 # Timeout / transient: strike, but keep the replica in
                 # rotation until the consecutive-failure threshold — it
-                # contributes no stats this tick.
-                n = self._health_fails.get(aid, 0) + 1
-                self._health_fails[aid] = n
+                # contributes no stats this tick. At most one strike per
+                # probe window (overlapping reconciles share the window).
+                now = time.monotonic()
+                n, last = self._health_fails.get(aid, (0, 0.0))
+                if now - last >= probe_timeout * 0.5:
+                    n += 1
+                    self._health_fails[aid] = (n, now)
                 if n >= fail_limit:
                     self._health_fails.pop(aid, None)
                     drop.add(aid)
         # Drop strike bookkeeping for replicas no longer tracked anywhere.
         if only is None:
-            seen_aids = {aid for (_n, aid, _r, _w) in probes}
+            seen_aids = {aid for (_n, aid, _r, _w, _s) in probes}
             for aid in list(self._health_fails):
                 if aid not in seen_aids:
                     del self._health_fails[aid]
+        start_timeout = getattr(
+            self._cfg, "serve_replica_start_timeout_s", 180.0)
         with self._lock:
-            for name, (gen, drop, stats) in probed.items():
+            for name, (gen, drop, promote, drop_start, stats) in \
+                    probed.items():
                 d = self.deployments.get(name)
                 if d is None or d["generation"] != gen:
                     continue  # redeployed/deleted mid-probe
+                d.setdefault("starting", [])
                 changed = bool(drop)
                 if drop:
                     d["replicas"] = [
                         (aid, h) for (aid, h) in d["replicas"]
                         if aid not in drop
                     ]
+                now = time.monotonic()
+                keep_starting = []
+                for aid, h, spawned in d["starting"]:
+                    if aid in promote:
+                        d["replicas"].append((aid, h))
+                        changed = True
+                    elif aid in drop_start:
+                        changed = True
+                    elif now - spawned > start_timeout:
+                        # Stuck boot: replace it (capacity loop below).
+                        try:
+                            ray_tpu.kill(h)
+                        except Exception:
+                            pass
+                        changed = True
+                    else:
+                        keep_starting.append((aid, h, spawned))
+                d["starting"] = keep_starting
                 self._autoscale_decision(d, stats)
-                while len(d["replicas"]) > d["num_replicas"]:
-                    self._drain_replicas(d, keep=d["num_replicas"])
+                total = len(d["replicas"]) + len(d["starting"])
+                while total > d["num_replicas"]:
+                    if d["starting"]:
+                        # Shed unrouted capacity first — killing a booting
+                        # replica cancels work no client is waiting on.
+                        _aid, h, _t = d["starting"].pop()
+                        try:
+                            ray_tpu.kill(h)
+                        except Exception:
+                            pass
+                    else:
+                        self._drain_replicas(d, keep=d["num_replicas"])
+                    total = len(d["replicas"]) + len(d["starting"])
                     changed = True
-                while len(d["replicas"]) < d["num_replicas"]:
+                while total < d["num_replicas"]:
                     opts = {"max_concurrency": max(2, d["max_concurrent_queries"])}
                     if d["resources"]:
                         opts["resources"] = d["resources"]
@@ -472,7 +545,9 @@ class ServeController:
                         d["cls_blob"], d["init_args"], d["init_kwargs"],
                         d["user_config"], name,
                     )
-                    d["replicas"].append((h._actor_id.hex(), h))
+                    d["starting"].append(
+                        (h._actor_id.hex(), h, time.monotonic()))
+                    total += 1
                     changed = True
                 if changed:
                     self._bump_version_locked()

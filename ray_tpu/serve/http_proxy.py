@@ -145,6 +145,10 @@ class HTTPProxy(_RouterMixin):
         # start must occupy one pool thread, not all of them.
         self._dep_locks: dict[str, asyncio.Lock] = {}
         self._loop = asyncio.new_event_loop()
+        # Router state must exist before the listener accepts anything — an
+        # early connection would otherwise hit missing attributes instead
+        # of a clean 404.
+        self._init_router()
         self._thread = threading.Thread(
             target=self._serve, args=(host, port), daemon=True)
         self._thread.start()
@@ -152,7 +156,6 @@ class HTTPProxy(_RouterMixin):
             raise RuntimeError("ingress server failed to start within 30s")
         if self._bind_error is not None:
             raise self._bind_error
-        self._init_router()
 
     # ------------------------------------------------------------ server
 
@@ -498,10 +501,11 @@ def start_proxy(port: int = 0, impl: str = "async"):
     return proxy, actual
 
 
-def start_proxies(port: int = 0):
+def start_proxies(port: int = 0, host: str = "0.0.0.0"):
     """One ingress proxy per alive node (the reference's per-node
-    HTTPProxyActor layout, http_proxy.py:386). Returns
-    {node_id: (handle, port)}."""
+    HTTPProxyActor layout, http_proxy.py:386). Binds every interface by
+    default so remote clients can reach each node's ingress. Returns
+    {node_id: (handle, (node_ip, port))}."""
     import ray_tpu
     from ray_tpu.utils.scheduling_strategies import (
         NodeAffinitySchedulingStrategy,
@@ -516,6 +520,8 @@ def start_proxies(port: int = 0):
             name=f"ray_tpu_serve_proxy_{nid[:12]}", get_if_exists=True,
             max_concurrency=32,
             scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=nid),
-        ).remote(port=port)
-        out[nid] = (proxy, ray_tpu.get(proxy.get_port.remote(), timeout=60))
+        ).remote(host=host, port=port)
+        bound = ray_tpu.get(proxy.get_port.remote(), timeout=60)
+        node_ip = (n.get("Address") or ("127.0.0.1",))[0]
+        out[nid] = (proxy, (node_ip, bound))
     return out

@@ -29,6 +29,10 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 # Subprocesses (workers, multi-process train backends) inherit via env.
 os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+# Machine-persistent pip runtime-env cache: the venv-build test costs ~60s
+# per fresh session dir; content-addressed digests make reuse safe.
+os.environ.setdefault("RAY_TPU_PIP_ENV_CACHE_DIR",
+                      "/tmp/ray_tpu_pip_env_cache")
 
 
 @pytest.fixture(scope="session")

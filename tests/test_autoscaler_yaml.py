@@ -56,7 +56,9 @@ def test_gcp_tpu_provider_with_fake_gcloud(tmp_path, monkeypatch):
     state = tmp_path / "state.json"
     state.write_text("[]")
     fake = tmp_path / "gcloud"
-    fake.write_text(f"""#!/usr/bin/env python3
+    # -S skips the sitecustomize (which eagerly imports jax, ~2s per gcloud
+    # call — the provider shells out several times).
+    fake.write_text(f"""#!/usr/bin/env -S python3 -S -E
 import json, sys
 state_path = {str(state)!r}
 args = sys.argv[1:]

@@ -355,3 +355,143 @@ class TestStatsAndSizeAwareRepartition:
             ds.repartition()
         with pytest.raises(ValueError):
             ds.repartition(4, target_block_size_bytes=100)
+
+
+class TestOpBreadth:
+    """VERDICT r3 item 7: zip/limit/add_column/random_sample
+    (ref: python/ray/data/dataset.py:141 surface)."""
+
+    def test_add_column(self, cluster):
+        ds = rd.from_items([{"a": i} for i in range(10)], parallelism=3)
+        out = ds.add_column("b", lambda batch: batch["a"] * 2).take_all()
+        assert [r["b"] for r in out] == [2 * i for i in range(10)]
+
+    def test_limit_preserves_order_and_slices(self, cluster):
+        ds = rd.from_items([{"a": i} for i in range(20)], parallelism=4)
+        out = ds.limit(7).take_all()
+        assert [r["a"] for r in out] == list(range(7))
+        assert ds.limit(100).count() == 20
+
+    def test_random_sample_deterministic_with_seed(self, cluster):
+        ds = rd.from_items([{"a": i} for i in range(200)], parallelism=4)
+        s1 = ds.random_sample(0.3, seed=7).take_all()
+        s2 = ds.random_sample(0.3, seed=7).take_all()
+        assert s1 == s2
+        assert 20 < len(s1) < 110  # ~60 expected
+        full = ds.random_sample(1.0, seed=1)
+        assert full.count() == 200
+
+    def test_zip_aligns_mismatched_block_boundaries(self, cluster):
+        a = rd.from_items([{"x": i} for i in range(12)], parallelism=3)
+        b = rd.from_items([{"y": 100 + i} for i in range(12)], parallelism=4)
+        out = a.zip(b).take_all()
+        assert [r["x"] for r in out] == list(range(12))
+        assert [r["y"] for r in out] == [100 + i for i in range(12)]
+
+    def test_zip_suffixes_colliding_columns(self, cluster):
+        a = rd.from_items([{"x": i} for i in range(6)], parallelism=2)
+        b = rd.from_items([{"x": -i} for i in range(6)], parallelism=2)
+        out = a.zip(b).take_all()
+        assert [r["x_1"] for r in out] == [-i for i in range(6)]
+
+    def test_zip_rejects_count_mismatch(self, cluster):
+        a = rd.from_items([{"x": i} for i in range(5)])
+        b = rd.from_items([{"y": i} for i in range(6)])
+        with pytest.raises(Exception):
+            a.zip(b).materialize()
+
+
+class TestDynamicBlockSplitting:
+    """VERDICT r3 item 7: map outputs above target_max_block_size split
+    into sub-blocks (ref: data/context.py:29 target_max_block_size)."""
+
+    def test_expanding_flat_map_splits_blocks(self, cluster):
+        from ray_tpu.data import DataContext
+
+        ctx = DataContext.get_current()
+        old = ctx.target_max_block_size
+        ctx.target_max_block_size = 4096
+        try:
+            # One input block explodes to ~100 rows x 800B = 80KB >> 4KB.
+            ds = rd.from_items([{"n": 100}], parallelism=1)
+            big = ds.flat_map(
+                lambda r: [{"v": np.zeros(100)} for _ in range(r["n"])]
+            ).materialize()
+            assert big.num_blocks() > 10, big.num_blocks()
+            assert big.count() == 100
+            # Every block is bounded near the target.
+            from ray_tpu.data import block as B2
+
+            blocks = ray_tpu.get(big._block_refs, timeout=120)
+            sizes = [B2.size_bytes(b) for b in blocks]
+            assert max(sizes) <= 4096 * 2, sizes
+        finally:
+            ctx.target_max_block_size = old
+
+    def test_small_outputs_do_not_split(self, cluster):
+        ds = rd.from_items([{"a": i} for i in range(10)], parallelism=2)
+        out = ds.map(lambda r: {"a": r["a"] + 1}).materialize()
+        assert out.num_blocks() == 2
+        assert out.count() == 10
+
+
+class TestStreamingActorPool:
+    """VERDICT r3 item 8: ready-queue dispatch — results stream to
+    consumers while the pool is still working; bounded wait windows
+    (ref: data/_internal/compute.py:88)."""
+
+    def test_results_stream_before_stage_completes(self, cluster):
+        import time as _time
+
+        from ray_tpu.core import serialization
+        from ray_tpu.data.compute import ActorPoolStrategy, run_actor_map
+
+        def make_apply():
+            def apply(blk):
+                _time.sleep(0.8)
+                return blk
+
+            return apply
+
+        blocks = [ray_tpu.put([{"a": i} for i in range(4)])
+                  for _ in range(6)]
+        t0 = _time.perf_counter()
+        refs = run_actor_map(
+            serialization.pack(make_apply), blocks,
+            ActorPoolStrategy(min_size=2, max_size=2,
+                              max_tasks_in_flight=2))
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=60)
+        t_first = _time.perf_counter() - t0
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=60)
+        t_all = _time.perf_counter() - t0
+        assert len(ready) >= 1
+        # 6 blocks x 0.8s over 2 actors = 3 rounds; the first block must be
+        # consumable at least one full round before the stage drains. The
+        # pre-rework barrier implementation waited for ALL blocks before
+        # returning refs, making this gap ~0.
+        assert t_all - t_first > 0.5, (t_first, t_all)
+
+    def test_many_blocks_bounded_dispatch(self, cluster):
+        """1k tiny blocks through a small pool: the dispatch loop touches
+        only the in-flight window per round, so this completes in seconds,
+        not the quadratic-scan blowup of the previous implementation."""
+        import time as _time
+
+        from ray_tpu.core import serialization
+        from ray_tpu.data.compute import ActorPoolStrategy, run_actor_map
+
+        def make_apply():
+            return lambda blk: blk
+
+        blocks = [ray_tpu.put([0, 1]) for _ in range(1000)]
+        t0 = _time.perf_counter()
+        refs = run_actor_map(
+            serialization.pack(make_apply), blocks,
+            ActorPoolStrategy(min_size=4, max_size=4,
+                              max_tasks_in_flight=4))
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=300)
+        wall = _time.perf_counter() - t0
+        assert len(refs) == 1000
+        vals = ray_tpu.get(refs[::250], timeout=60)
+        assert all(v == [0, 1] for v in vals)
+        assert wall < 120, f"1k blocks took {wall:.1f}s"

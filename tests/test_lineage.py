@@ -1,6 +1,11 @@
 """Lineage reconstruction: lost objects rebuilt by re-executing their
 creating tasks (ref: object_recovery_manager.h:41,90) — the VERDICT r1
 "done" bar: kill a node holding blocks mid-get; the get completes.
+
+The cluster fixture is module-scoped (per-test cluster boots dominated CI
+wall time); each test sacrifices its OWN victim node tagged with a
+test-unique resource, so an earlier test's replacement node can never
+absorb a later test's "special" tasks and mask the reconstruction path.
 """
 
 import time
@@ -12,7 +17,7 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
 
-@pytest.fixture
+@pytest.fixture(scope="module")
 def cluster():
     c = Cluster(head_node_args={"num_cpus": 2})
     ray_tpu.init(address=c.address)
@@ -21,25 +26,25 @@ def cluster():
     c.shutdown()
 
 
-def _on_special(**extra):
-    return ray_tpu.remote(resources={"special": 0.01}, **extra)
+def _alive() -> int:
+    return sum(1 for n in ray_tpu.nodes() if n["Alive"])
 
 
-def test_node_death_rebuilds_task_output(cluster):
-    """Outputs stored only on a dead node are rebuilt from lineage."""
-    victim = cluster.add_node(num_cpus=2, resources={"special": 1})
-    cluster.wait_for_nodes(2)
+def _wait_alive(k: int, timeout: float = 30.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if _alive() == k:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"alive nodes never reached {k} (now {_alive()})")
 
-    @_on_special()
-    def blob(tag):
-        return np.full(1 << 17, tag, np.uint8)  # 128 KiB → stored in shm
 
-    refs = [blob.remote(i) for i in range(3)]
-    ray_tpu.get(refs, timeout=60)  # materialized on the victim node
-    cluster.remove_node(victim)
-    cluster.add_node(num_cpus=2, resources={"special": 1})
-    cluster.wait_for_nodes(2)
-    # Drop cached local copies so the driver must refetch from the cluster.
+def _on(res: str, **extra):
+    return ray_tpu.remote(resources={res: 0.01}, **extra)
+
+
+def _drop_local_copies(refs) -> None:
+    """Force the driver to refetch from the cluster."""
     client = ray_tpu.api._client
     for r in refs:
         client._memory_store.pop(r.id.binary(), None)
@@ -49,6 +54,24 @@ def test_node_death_rebuilds_task_output(cluster):
                 mv.release()
             except BufferError:
                 pass
+
+
+def test_node_death_rebuilds_task_output(cluster):
+    """Outputs stored only on a dead node are rebuilt from lineage."""
+    base_alive = _alive()
+    victim = cluster.add_node(num_cpus=2, resources={"sp_rebuild": 1})
+    _wait_alive(base_alive + 1)
+
+    @_on("sp_rebuild")
+    def blob(tag):
+        return np.full(1 << 17, tag, np.uint8)  # 128 KiB → stored in shm
+
+    refs = [blob.remote(i) for i in range(3)]
+    ray_tpu.get(refs, timeout=60)  # materialized on the victim node
+    cluster.remove_node(victim)
+    cluster.add_node(num_cpus=2, resources={"sp_rebuild": 1})
+    _wait_alive(base_alive + 1)
+    _drop_local_copies(refs)
     out = ray_tpu.get(refs, timeout=90)
     assert [int(a[0]) for a in out] == [0, 1, 2]
 
@@ -56,14 +79,15 @@ def test_node_death_rebuilds_task_output(cluster):
 def test_transitive_reconstruction(cluster):
     """A lost object whose creating task's *argument* is also lost rebuilds
     the whole chain."""
-    victim = cluster.add_node(num_cpus=2, resources={"special": 1})
-    cluster.wait_for_nodes(2)
+    base_alive = _alive()
+    victim = cluster.add_node(num_cpus=2, resources={"sp_trans": 1})
+    _wait_alive(base_alive + 1)
 
-    @_on_special()
+    @_on("sp_trans")
     def base():
         return np.arange(1 << 15, dtype=np.int64)  # 256 KiB
 
-    @_on_special()
+    @_on("sp_trans")
     def double(x):
         return x * 2
 
@@ -71,17 +95,9 @@ def test_transitive_reconstruction(cluster):
     c = double.remote(b)
     assert int(ray_tpu.get(c, timeout=60)[3]) == 6
     cluster.remove_node(victim)
-    cluster.add_node(num_cpus=2, resources={"special": 1})
-    cluster.wait_for_nodes(2)
-    client = ray_tpu.api._client
-    for r in (b, c):
-        client._memory_store.pop(r.id.binary(), None)
-        mv = client._mmaps.pop(r.id.binary(), None)
-        if mv is not None:
-            try:
-                mv.release()
-            except BufferError:
-                pass
+    cluster.add_node(num_cpus=2, resources={"sp_trans": 1})
+    _wait_alive(base_alive + 1)
+    _drop_local_copies([b, c])
     out = ray_tpu.get(c, timeout=90)
     assert int(out[5]) == 10
 
@@ -89,14 +105,15 @@ def test_transitive_reconstruction(cluster):
 def test_chain_survives_dropped_intermediate_ref(cluster):
     """`del b` after submitting double(b): b's lineage stays pinned through
     c's spec (lineage deps), so c still reconstructs after loss."""
-    victim = cluster.add_node(num_cpus=2, resources={"special": 1})
-    cluster.wait_for_nodes(2)
+    base_alive = _alive()
+    victim = cluster.add_node(num_cpus=2, resources={"sp_chain": 1})
+    _wait_alive(base_alive + 1)
 
-    @_on_special()
+    @_on("sp_chain")
     def base():
         return np.ones(1 << 15, np.int64)
 
-    @_on_special()
+    @_on("sp_chain")
     def tripled(x):
         return x * 3
 
@@ -105,25 +122,15 @@ def test_chain_survives_dropped_intermediate_ref(cluster):
     del b
     assert int(ray_tpu.get(c, timeout=60)[0]) == 3
     cluster.remove_node(victim)
-    cluster.add_node(num_cpus=2, resources={"special": 1})
-    cluster.wait_for_nodes(2)
-    client = ray_tpu.api._client
-    client._memory_store.pop(c.id.binary(), None)
-    mv = client._mmaps.pop(c.id.binary(), None)
-    if mv is not None:
-        try:
-            mv.release()
-        except BufferError:
-            pass
+    cluster.add_node(num_cpus=2, resources={"sp_chain": 1})
+    _wait_alive(base_alive + 1)
+    _drop_local_copies([c])
     assert int(ray_tpu.get(c, timeout=90)[1]) == 3
 
 
 def test_lost_put_restored_from_owner_copy(cluster):
     """put() objects aren't task-recreatable, but the owner holds the value
     and re-stores it (strictly better than the reference, which fails)."""
-    # Store the put on a remote node by having a remote task hold nothing —
-    # puts go to the local (head) store, so instead verify restore after an
-    # explicit free of the head store copy.
     ref = ray_tpu.put(np.arange(64, dtype=np.int64))
     client = ray_tpu.api._client
     # Simulate loss: free in the node store + directory, keep our ref.
@@ -144,10 +151,11 @@ def test_dynamic_generator_items_recover(cluster):
     their ids derive from the creating task, so replaying the generator
     re-stores them (VERDICT r2 weak #10 — previously a documented
     limitation)."""
-    victim = cluster.add_node(num_cpus=2, resources={"special": 1})
-    cluster.wait_for_nodes(2)
+    base_alive = _alive()
+    victim = cluster.add_node(num_cpus=2, resources={"sp_dyn": 1})
+    _wait_alive(base_alive + 1)
 
-    @_on_special(num_returns="dynamic", max_retries=2)
+    @_on("sp_dyn", num_returns="dynamic", max_retries=2)
     def gen(n):
         for i in range(n):
             yield np.full(1 << 17, i, np.uint8)  # each item in shm
@@ -158,17 +166,9 @@ def test_dynamic_generator_items_recover(cluster):
     assert int(ray_tpu.get(item_refs[1], timeout=60)[0]) == 1
 
     cluster.remove_node(victim)
-    cluster.add_node(num_cpus=2, resources={"special": 1})
-    cluster.wait_for_nodes(2)
-    client = ray_tpu.api._client
-    for r in item_refs:
-        client._memory_store.pop(r.id.binary(), None)
-        mv = client._mmaps.pop(r.id.binary(), None)
-        if mv is not None:
-            try:
-                mv.release()
-            except BufferError:
-                pass
+    cluster.add_node(num_cpus=2, resources={"sp_dyn": 1})
+    _wait_alive(base_alive + 1)
+    _drop_local_copies(item_refs)
 
     vals = ray_tpu.get(list(item_refs), timeout=120)
     assert [int(v[0]) for v in vals] == [0, 1, 2]

@@ -249,10 +249,10 @@ class TestServeIntegration:
             toks = []
             t0 = time.perf_counter()
             for tok in handle.stream(
-                    {"prompt_ids": [5, 9, 2], "max_tokens": 120}):
+                    {"prompt_ids": [5, 9, 2], "max_tokens": 64}):
                 arrivals.append(time.perf_counter() - t0)
                 toks.append(tok)
-            assert len(toks) == 120
+            assert len(toks) == 64
             # First token must land in a fraction of total stream time.
             assert arrivals[0] < arrivals[-1] * 0.5, (
                 f"first token at {arrivals[0]:.3f}s vs last "
@@ -264,7 +264,7 @@ class TestServeIntegration:
             _proxy, port = start_proxy()
             time.sleep(1.0)  # route table refresh
             body = json.dumps({"prompt_ids": [5, 9, 2],
-                               "max_tokens": 120, "stream": True}).encode()
+                               "max_tokens": 64, "stream": True}).encode()
             req = (b"POST /llm HTTP/1.1\r\nHost: x\r\n"
                    b"Content-Type: application/json\r\n"
                    b"Content-Length: " + str(len(body)).encode() +
@@ -286,7 +286,7 @@ class TestServeIntegration:
             # (split on b"\n\n" would glue the first event to the \r\n\r\n
             # header terminator — count events directly)
             n_tokens = buf.count(b'data: {"token"')
-            assert n_tokens == 120, f"got {n_tokens} token events"
+            assert n_tokens == 64, f"got {n_tokens} token events"
             t_first = next(t for t, d in chunks if b"data: {" in d)
             t_done = chunks[-1][0]
             assert t_first < t_done * 0.5, (

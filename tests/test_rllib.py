@@ -431,3 +431,57 @@ class TestIMPALA:
         assert best > 100, (
             f"IMPALA did not learn CartPole: first={first} best={best}")
         algo.stop()
+
+
+class TestMultiAgent:
+    def test_env_contract_and_separate_episodes(self):
+        from ray_tpu.rllib import MultiAgentCartPole
+
+        env = MultiAgentCartPole(num_agents=2, seed=0)
+        obs = env.reset()
+        assert set(obs) == {"agent_0", "agent_1"}
+        assert obs["agent_0"].shape == (4,)
+        o, r, d, t = env.step({"agent_0": 0, "agent_1": 1})
+        assert set(r) == {"agent_0", "agent_1"}
+        assert all(v == 1.0 for v in r.values())
+
+    def test_two_policies_learn_separately(self):
+        """VERDICT r3 item 9 done-bar: PPO trains TWO policies in one env
+        with separate per-policy returns (ref: multi_agent_env.py +
+        policy_map.py)."""
+        from ray_tpu.rllib import MultiAgentCartPole, MultiAgentPPOConfig
+
+        cfg = (MultiAgentPPOConfig()
+               .environment(lambda: MultiAgentCartPole(num_agents=2, seed=0),
+                            seed=0)
+               .rollouts(rollout_fragment_length=256)
+               .training(lr=3e-4, num_sgd_iter=8, sgd_minibatch_size=128,
+                         entropy_coeff=0.01))
+        cfg.multi_agent(
+            policies=("pol_a", "pol_b"),
+            policy_mapping_fn=lambda aid: ("pol_a" if aid == "agent_0"
+                                           else "pol_b"))
+        algo = cfg.build()
+        assert set(algo.policy_map) == {"pol_a", "pol_b"}
+        # Policies are independent parameter sets.
+        wa = algo.policy_map["pol_a"].params
+        wb = algo.policy_map["pol_b"].params
+        assert not np.allclose(np.asarray(wa["pi"][0]["w"]),
+                               np.asarray(wb["pi"][0]["w"]))
+        result = None
+        best = {"pol_a": -1e9, "pol_b": -1e9}
+        for _ in range(30):
+            result = algo.train()
+            pr = result["policy_reward_mean"]
+            for pid, v in pr.items():
+                if v is not None:
+                    best[pid] = max(best[pid], v)
+            if min(best.values()) > 70:
+                break
+        # CartPole random baseline ≈ 20; both policies must improve from
+        # their OWN experience.
+        assert best["pol_a"] > 70, best
+        assert best["pol_b"] > 70, best
+        assert result["timesteps_total"] > 0
+        ckpt = algo.get_weights()
+        algo.set_weights(ckpt)

@@ -74,7 +74,7 @@ def test_pip_env_visible_in_task_not_driver(cluster, wheel_path):
     assert version == PKG_VERSION
     assert msg == f"hello from {PKG_NAME}"
     # The worker ran on the venv interpreter, not the base one.
-    assert "runtime_envs" in exe and exe != sys.executable
+    assert "/venv/bin/python" in exe and exe != sys.executable
 
     # A task WITHOUT the env (base pool) cannot see the package.
     @ray_tpu.remote
