@@ -1679,8 +1679,10 @@ class CoreClient:
                         # published address by many seconds under load, and
                         # a genuinely dead worker is reported through the
                         # raylet death path anyway (st.dead short-circuits
-                        # this loop). ~30s of refusals before escalating.
-                        if dial_fails >= 120:
+                        # this loop). ~10s of refusals before escalating —
+                        # well inside the enclosing attempt budget, so the
+                        # re-resolve path actually runs.
+                        if dial_fails >= 40:
                             spec._dial_fails = 0
                             st.address = None
                             st.conn = None
@@ -1689,6 +1691,7 @@ class CoreClient:
                                 st, f"dial failed: {e!r}"))
                         await asyncio.sleep(0.25)
                         continue
+                    spec._dial_fails = 0
                     st.conn = conn
                 spec.seq_no = next(st.seq)
                 entry = (self._task_index.get(spec.return_ids[0])

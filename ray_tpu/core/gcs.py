@@ -71,6 +71,15 @@ class GcsServer:
         self.config = config
         self.snapshot_path = snapshot_path
         self.server = rpc.Server(host, port)
+        # Structured cluster event log (ref: src/ray/util/event.h +
+        # dashboard/modules/event): bounded ring of {seq, ts, severity,
+        # source, type, message, **extra} records for post-mortems —
+        # node/actor lifecycle, OOM kills, PG churn. Raylets/workers
+        # append via "event_add"; consumers page with "events_get".
+        import collections as _collections
+
+        self.events: _collections.deque = _collections.deque(maxlen=10_000)
+        self._event_seq = 0
         self.nodes: dict[bytes, NodeInfo] = {}
         self.actors: dict[bytes, ActorInfo] = {}
         self.named_actors: dict[str, bytes] = {}
@@ -105,6 +114,32 @@ class GcsServer:
         self._register_handlers()
 
     # ---------- pubsub ----------
+
+    def record_event(self, type_: str, message: str, *,
+                     severity: str = "INFO", source: str = "gcs",
+                     **extra) -> None:
+        self._event_seq += 1
+        self.events.append({
+            "seq": self._event_seq, "ts": time.time(),
+            "severity": severity, "source": source, "type": type_,
+            "message": message, **extra,
+        })
+
+    async def _h_event_add(self, conn, p):
+        self.record_event(
+            p.get("type", "custom"), p.get("message", ""),
+            severity=p.get("severity", "INFO"),
+            source=p.get("source", "unknown"),
+            **{k: v for k, v in p.items()
+               if k not in ("type", "message", "severity", "source",
+                            "seq", "ts")})
+        return {"ok": True}
+
+    async def _h_events_get(self, conn, p):
+        after = p.get("after_seq", 0)
+        limit = p.get("limit", 1000)
+        out = [e for e in self.events if e["seq"] > after]
+        return {"events": out[-limit:], "latest_seq": self._event_seq}
 
     def publish(self, channel: str, msg: Any) -> None:
         dead = []
@@ -151,6 +186,8 @@ class GcsServer:
         s.register("pg_remove", self._pg_remove)
         s.register("pg_get", self._pg_get)
         s.register("pg_list", self._pg_list)
+        s.register("event_add", self._h_event_add)
+        s.register("events_get", self._h_events_get)
         s.register("profile_add", self._profile_add)
         s.register("profile_get", self._profile_get)
         s.register("metrics_push", self._metrics_push)
@@ -181,6 +218,10 @@ class GcsServer:
         self.publish("node", {"event": "added", "node_id": node_id,
                               "address": info.address,
                               "resources": info.resources_total})
+        self.record_event(
+            "NODE_ADDED", f"node {node_id.hex()[:8]} joined",
+            node_id=node_id.hex(), address=list(info.address),
+            resources=info.resources_total)
         return {"ok": True}
 
     async def _heartbeat(self, conn, p):
@@ -524,6 +565,9 @@ class GcsServer:
         info.placing = False
         if p.get("node_id"):
             info.node_id = p["node_id"]
+        self.record_event(
+            "ACTOR_ALIVE", f"actor {p['actor_id'].hex()[:8]} alive",
+            actor_id=p["actor_id"].hex())
         self.publish("actor", {"actor_id": p["actor_id"], "state": ALIVE,
                                "address": info.address})
         self._wal_actor(info)
@@ -552,10 +596,21 @@ class GcsServer:
                     self.named_actors.pop(info.name, None)
                 self.publish("actor", {"actor_id": p["actor_id"], "state": DEAD,
                                        "cause": info.death_cause})
+                self.record_event(
+                    "ACTOR_DIED",
+                    f"actor {p['actor_id'].hex()[:8]} died: "
+                    f"{info.death_cause}",
+                    severity="ERROR", actor_id=p["actor_id"].hex(),
+                    cause=str(info.death_cause))
                 self._wal_actor(info)
                 return {"ok": True, "restart": False, "cause": info.death_cause}
             info.num_restarts += 1
             info.state = RESTARTING
+            self.record_event(
+                "ACTOR_RESTARTING",
+                f"actor {p['actor_id'].hex()[:8]} restarting "
+                f"({info.num_restarts} so far)",
+                severity="WARNING", actor_id=p["actor_id"].hex())
             info.address = None
             info.placing = False
             self._wal_actor(info)   # restart budget must survive a GCS crash
@@ -613,6 +668,10 @@ class GcsServer:
             self.named_actors.pop(info.name, None)
         self.publish("actor", {"actor_id": p["actor_id"], "state": DEAD,
                                "cause": "killed"})
+        self.record_event(
+            "ACTOR_DIED", f"actor {p['actor_id'].hex()[:8]} killed",
+            severity="WARNING", actor_id=p["actor_id"].hex(),
+            cause="ray_tpu.kill")
         self._wal_actor(info)
         return {"ok": True, "address": addr}
 
@@ -836,6 +895,9 @@ class GcsServer:
         for obj, locs in list(self.object_dir.items()):
             locs.discard(node_id)
         self.publish("node", {"event": "dead", "node_id": node_id})
+        self.record_event(
+            "NODE_DIED", f"node {node_id.hex()[:8]} died ({why})",
+            severity="ERROR", node_id=node_id.hex(), cause=str(why))
         # Fail-over actors that lived there.
         for info_a in list(self.actors.values()):
             if info_a.node_id == node_id and info_a.state in (ALIVE, PENDING):

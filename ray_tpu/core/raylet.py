@@ -620,6 +620,20 @@ class Raylet:
                         os.kill(victim.pid, 9)
                     except ProcessLookupError:
                         pass
+                # Durable post-mortem trail (dashboard /api/events).
+                try:
+                    spawn(self.gcs.call("event_add", {
+                        "type": "WORKER_OOM_KILLED", "severity": "WARNING",
+                        "source": f"raylet:{NodeID(self.node_id).hex()[:8]}",
+                        "message": (
+                            f"memory pressure (host {frac * 100:.0f}%): "
+                            f"killed worker "
+                            f"{WorkerID(victim.worker_id).hex()[:8]}"),
+                        "node_id": NodeID(self.node_id).hex(),
+                        "pid": victim.pid,
+                    }))
+                except Exception:
+                    pass
                 # disconnect handling returns resources + pumps the queue
             except Exception:
                 logger.exception("memory monitor iteration failed")

@@ -89,6 +89,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if self.path == "/api/timeline":
                 return self._json(state.timeline())
+            if self.path == "/api/events":
+                return self._json(state.list_cluster_events())
             if self.path in ("/api/jobs", "/api/jobs/"):
                 return self._json(ray_tpu.get(
                     self.server.jobs.list.remote(), timeout=30))

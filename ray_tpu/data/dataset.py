@@ -32,6 +32,10 @@ class MapStage:
 
     name: str
     fn: Callable[[Any], Any]
+    # Whether this transform can GROW a block (flat_map, map_batches with
+    # user batch fns). Gates dynamic block splitting: non-expanding chains
+    # (map/filter/add_column) stay fully lazy — no driver-side barrier.
+    can_expand: bool = False
 
 
 @dataclass
@@ -173,18 +177,21 @@ class Dataset:
             if isinstance(stage, MapStage):
                 fns = []
                 names = []
+                can_expand = False
                 while i < len(self._stages) and isinstance(
                     self._stages[i], MapStage
                 ):
                     fns.append(self._stages[i].fn)
                     names.append(self._stages[i].name)
+                    can_expand = can_expand or self._stages[i].can_expand
                     i += 1
                 packed = serialization.pack(_fused_map(fns))
                 from ray_tpu.data.context import DataContext
 
                 ctx = DataContext.get_current()
                 target = (ctx.target_max_block_size
-                          if ctx.enable_dynamic_block_splitting else 0)
+                          if ctx.enable_dynamic_block_splitting
+                          and can_expand else 0)
                 if target:
                     # Dynamic block splitting: each task may yield several
                     # sub-blocks; resolving the outer generator refs is a
@@ -281,7 +288,8 @@ class Dataset:
         if isinstance(fn, type):
             raise ValueError(
                 "a callable class requires compute=ActorPoolStrategy(...)")
-        return self._with_stage(MapStage("map_batches", make_apply()))
+        return self._with_stage(
+            MapStage("map_batches", make_apply(), can_expand=True))
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         def apply(blk):
@@ -296,7 +304,8 @@ class Dataset:
                 out.extend(fn(r))
             return B.build_block(out)
 
-        return self._with_stage(MapStage("flat_map", apply))
+        return self._with_stage(
+            MapStage("flat_map", apply, can_expand=True))
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
         def apply(blk):

@@ -23,6 +23,12 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentPPO,
     MultiAgentPPOConfig,
 )
+from ray_tpu.rllib.offline import (
+    JsonReader,
+    JsonWriter,
+    OfflineDQN,
+    collect_dataset,
+)
 from ray_tpu.rllib.policy import Policy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
@@ -34,7 +40,8 @@ __all__ = [
     "A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig",
     "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
-    "MultiAgentPPOConfig",
+    "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
+    "collect_dataset",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
     "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",
     "Pendulum", "make_env", "register_env",

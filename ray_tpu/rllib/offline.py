@@ -1,0 +1,254 @@
+"""Offline RL: experience logging + training from logged datasets.
+
+Parity: `/root/reference/rllib/offline/json_reader.py:1` +
+`offline/json_writer.py` — episodes/transitions serialize to sharded
+JSONL files; a reader replays them as SampleBatches so off-policy
+algorithms (DQN here; CQL-style conservatism via the `bc_coeff` knob on
+OfflineDQN) train with NO environment interaction. Columns store as
+base64-encoded little-endian arrays (JSON-safe, exact round-trip).
+"""
+
+from __future__ import annotations
+
+import base64
+import glob
+import json
+import os
+from typing import Iterator
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _enc(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    return {"__np__": base64.b64encode(a.tobytes()).decode("ascii"),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _dec(d: dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(d["__np__"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"]).copy()
+
+
+class JsonWriter:
+    """Append SampleBatches to sharded JSONL files
+    (ref: offline/json_writer.py)."""
+
+    def __init__(self, path: str, *, max_file_size: int = 64 * 1024**2):
+        self.path = path
+        self.max_file_size = max_file_size
+        os.makedirs(path, exist_ok=True)
+        self._f = None
+        self._shard = 0
+
+    def _file(self):
+        if self._f is not None and self._f.tell() < self.max_file_size:
+            return self._f
+        if self._f is not None:
+            self._f.close()
+            self._shard += 1
+        self._f = open(os.path.join(
+            self.path, f"batch-{self._shard:05d}.jsonl"), "a")
+        return self._f
+
+    def write(self, batch: SampleBatch) -> None:
+        row = {k: _enc(np.asarray(v)) for k, v in batch.items()}
+        f = self._file()
+        f.write(json.dumps(row) + "\n")
+        f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class JsonReader:
+    """Replay logged SampleBatches (ref: offline/json_reader.py). `path`
+    is a directory of JSONL shards or a single file; iteration loops
+    forever (epoch after epoch), shuffling shard order per epoch."""
+
+    def __init__(self, path: str, *, seed: int = 0):
+        if os.path.isdir(path):
+            self.files = sorted(glob.glob(os.path.join(path, "*.jsonl")))
+        else:
+            self.files = [path]
+        if not self.files:
+            raise FileNotFoundError(f"no offline data under {path!r}")
+        self._rng = np.random.default_rng(seed)
+
+    def iter_batches(self) -> Iterator[SampleBatch]:
+        while True:
+            order = self._rng.permutation(len(self.files))
+            for i in order:
+                with open(self.files[i]) as f:
+                    for line in f:
+                        row = json.loads(line)
+                        yield SampleBatch(
+                            {k: _dec(v) for k, v in row.items()})
+
+    def read_all(self) -> SampleBatch:
+        out = []
+        for fp in self.files:
+            with open(fp) as f:
+                for line in f:
+                    row = json.loads(line)
+                    out.append(SampleBatch(
+                        {k: _dec(v) for k, v in row.items()}))
+        return SampleBatch.concat(out)
+
+
+def collect_dataset(env_name: str, path: str, *, timesteps: int = 20_000,
+                    policy=None, epsilon: float = 0.3, seed: int = 0,
+                    num_envs: int = 8) -> str:
+    """Roll a behavior policy (or uniform-random when policy=None, mixed
+    with epsilon exploration otherwise) and log (obs, action, reward,
+    done, next_obs) transitions — the standard offline-RL dataset shape
+    (ref: offline/json_writer.py usage in rllib `output=` config)."""
+    import jax
+
+    from ray_tpu.rllib.env import make_env
+
+    env = make_env(env_name, num_envs=num_envs, seed=seed)
+    assert env.action_space.discrete, "collect_dataset: discrete actions"
+    rng = np.random.default_rng(seed)
+    writer = JsonWriter(path)
+    obs = env.reset()
+    steps = 0
+    while steps < timesteps:
+        if policy is None:
+            actions = rng.integers(0, env.action_space.n, env.num_envs)
+        else:
+            key = jax.random.key(rng.integers(2**31))
+            greedy, _lp, _vf = policy.compute_actions(obs, key)
+            explore = rng.random(env.num_envs) < epsilon
+            actions = np.where(
+                explore, rng.integers(0, env.action_space.n, env.num_envs),
+                greedy)
+        next_obs, reward, done, trunc = env.step(actions)
+        finished = np.logical_or(done, trunc)
+        stored_next = np.where(
+            finished.reshape((-1,) + (1,) * (next_obs.ndim - 1)),
+            env.final_obs, next_obs)
+        writer.write(SampleBatch({
+            sb.OBS: obs.astype(np.float32),
+            sb.ACTIONS: actions.astype(np.int64),
+            sb.REWARDS: reward.astype(np.float32),
+            sb.DONES: done,
+            sb.NEXT_OBS: stored_next.astype(np.float32),
+        }))
+        obs = next_obs
+        steps += env.num_envs
+    writer.close()
+    return path
+
+
+class OfflineDQN:
+    """DQN trained purely from a logged dataset — no environment stepping
+    (ref: the reference's `input_=...` offline config on DQN/CQL).
+
+    `bc_coeff > 0` adds a behavior-cloning regularizer (CQL-lite): the
+    Q-network is penalized for preferring actions far from the dataset's,
+    countering over-estimation on out-of-distribution actions.
+    """
+
+    def __init__(self, path: str, *, obs_dim: int, n_actions: int,
+                 hiddens=(64, 64), lr: float = 1e-3, gamma: float = 0.99,
+                 double_q: bool = True, bc_coeff: float = 0.0,
+                 target_update_freq: int = 500, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.policy import _init_mlp, _mlp
+
+        self.gamma = gamma
+        self.double_q = double_q
+        self.bc_coeff = bc_coeff
+        self.n_actions = n_actions
+        self.reader = JsonReader(path, seed=seed)
+        self.data = self.reader.read_all()
+        self._rng = np.random.default_rng(seed)
+        sizes = (obs_dim, *hiddens, n_actions)
+        self.params = _init_mlp(jax.random.key(seed), sizes, scale_last=0.01)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.target_update_freq = target_update_freq
+        self._updates = 0
+        self._mlp = _mlp
+
+        def update(params, opt_state, target_params, batch):
+            def loss_fn(params):
+                q = _mlp(params, batch[sb.OBS])
+                q_taken = jnp.take_along_axis(
+                    q, batch[sb.ACTIONS][:, None].astype(jnp.int32),
+                    axis=1)[:, 0]
+                q_next_t = _mlp(target_params, batch[sb.NEXT_OBS])
+                if double_q:
+                    best = jnp.argmax(_mlp(params, batch[sb.NEXT_OBS]), 1)
+                else:
+                    best = jnp.argmax(q_next_t, 1)
+                q_next = jnp.take_along_axis(q_next_t, best[:, None], 1)[:, 0]
+                target = batch[sb.REWARDS] + gamma * q_next * (
+                    1.0 - batch[sb.DONES].astype(jnp.float32))
+                td = q_taken - jax.lax.stop_gradient(target)
+                loss = jnp.mean(td ** 2)
+                if bc_coeff > 0:
+                    # CQL-lite conservatism: push down logsumexp(Q) while
+                    # holding up Q(dataset action).
+                    loss = loss + bc_coeff * jnp.mean(
+                        jax.scipy.special.logsumexp(q, axis=1) - q_taken)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def train_steps(self, n: int, batch_size: int = 256) -> float:
+        import jax
+        import jax.numpy as jnp
+
+        loss = None
+        for _ in range(n):
+            idx = self._rng.integers(0, self.data.count, batch_size)
+            batch = {k: jnp.asarray(np.asarray(v)[idx])
+                     for k, v in self.data.items()}
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, self.target_params, batch)
+            self._updates += 1
+            if self._updates % self.target_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+        return float(loss)
+
+    def evaluate(self, env_name: str, *, episodes: int = 20,
+                 seed: int = 1) -> float:
+        """Greedy rollout return of the learned Q policy."""
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.env import make_env
+
+        env = make_env(env_name, num_envs=4, seed=seed)
+        obs = env.reset()
+        returns: list[float] = []
+        running = np.zeros(env.num_envs, np.float64)
+        while len(returns) < episodes:
+            q = np.asarray(self._mlp(self.params, jnp.asarray(
+                obs.astype(np.float32))))
+            obs, reward, done, trunc = env.step(q.argmax(axis=1))
+            running += reward
+            for i in np.nonzero(np.logical_or(done, trunc))[0]:
+                returns.append(float(running[i]))
+                running[i] = 0.0
+        return float(np.mean(returns))
+
+
+__all__ = ["JsonReader", "JsonWriter", "OfflineDQN", "collect_dataset"]

@@ -86,7 +86,11 @@ class ServeController:
             d["over_since"] = None
             d["under_since"] = None
             d["cold_ts"] = None
-            d["starting"] = []
+            import time as _time
+
+            _now = _time.monotonic()
+            d["starting"] = [(a, h, _now)
+                             for (a, h) in rec.get("starting", [])]
             # Pickled (actor_id, handle) pairs: dead ones are filtered by
             # the first reconcile health probe; live ones are adopted as-is.
             d["replicas"] = rec["replicas"]
@@ -110,9 +114,12 @@ class ServeController:
             "version": self.version,
             "deployments": {
                 name: {**{k: d[k] for k in _CKPT_FIELDS},
-                       "replicas": (list(d["replicas"])
-                                    + [(a, h) for (a, h, _t)
-                                       in d.get("starting", [])])}
+                       "replicas": list(d["replicas"]),
+                       # Persisted separately: a restored booting replica
+                       # must re-enter STARTING (fresh timeout clock), not
+                       # the routable strike path.
+                       "starting": [(a, h) for (a, h, _t)
+                                    in d.get("starting", [])]}
                 for name, d in self.deployments.items()
             },
         }

@@ -409,6 +409,8 @@ class ThreadedHTTPProxy(_RouterMixin):
         self._init_router()
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"   # keep-alive, like the async proxy
+
             def log_message(self, *a):  # quiet
                 pass
 

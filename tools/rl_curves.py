@@ -27,9 +27,9 @@ def run_ppo_pixel(budget_s: float) -> dict:
 
     cfg = (PPOConfig()
            .environment("PixelCatchSmall-v0", seed=0)
-           .rollouts(num_rollout_workers=0, num_envs_per_worker=16,
+           .rollouts(num_rollout_workers=0, num_envs_per_worker=8,
                      rollout_fragment_length=64)
-           .training(lr=3e-4, num_sgd_iter=6, sgd_minibatch_size=256,
+           .training(lr=4e-4, num_sgd_iter=4, sgd_minibatch_size=256,
                      entropy_coeff=0.01, model_conv="nature"))
     algo = cfg.build()
     hist = []
