@@ -155,7 +155,8 @@ def _gpt_train_flops_per_token(cfg) -> float:
 
 
 def main() -> None:
-    metric = "gpt2_124m_train_tokens_per_sec_per_chip"
+    _model = os.environ.get("BENCH_MODEL", "gpt2_124m")
+    metric = f"{_model}_train_tokens_per_sec_per_chip"
     unit = "tokens/sec/chip"
 
     watchdog = _start_watchdog(
@@ -256,7 +257,7 @@ def main() -> None:
             except Exception:
                 pass
 
-        _emit({
+        out = {
             "metric": metric,
             "value": round(per_chip, 1),
             "unit": unit,
@@ -266,7 +267,17 @@ def main() -> None:
             "platform": platform,
             "n_devices": n_dev,
             "step_ms": round(dt / n_steps * 1e3, 2),
-        })
+        }
+        try:
+            # HBM high-water: ground truth for train/memory_audit.py's
+            # arithmetic (not all PJRT backends expose it).
+            stats = devs[0].memory_stats() or {}
+            peak_b = stats.get("peak_bytes_in_use")
+            if peak_b:
+                out["hbm_peak_gb"] = round(peak_b / 2**30, 3)
+        except Exception:
+            pass
+        _emit(out)
         watchdog.cancel()
     except Exception:
         _emit({

@@ -139,7 +139,11 @@ class GcsServer:
         after = p.get("after_seq", 0)
         limit = p.get("limit", 1000)
         out = [e for e in self.events if e["seq"] > after]
-        return {"events": out[-limit:], "latest_seq": self._event_seq}
+        # Forward-cursor paging: oldest-first after the cursor, so a
+        # consumer advancing after_seq never skips backlog events.
+        if limit and limit > 0:
+            out = out[:limit]
+        return {"events": out, "latest_seq": self._event_seq}
 
     def publish(self, channel: str, msg: Any) -> None:
         dead = []

@@ -19,6 +19,10 @@ class DataContext:
     # sub-blocks. 0 disables splitting.
     target_max_block_size: int = 128 * 1024**2
     enable_dynamic_block_splitting: bool = True
+    # True restricts the byte bound to expanding stages (flat_map /
+    # map_batches), keeping 1:1 map chains fully lazy — at the cost of
+    # unbounded output blocks from byte-inflating maps (e.g. decode).
+    split_expanding_only: bool = False
 
     _current: "DataContext | None" = None
 

@@ -189,9 +189,14 @@ class Dataset:
                 from ray_tpu.data.context import DataContext
 
                 ctx = DataContext.get_current()
+                # The byte bound applies to ALL map chains (a plain map
+                # can inflate bytes row-for-row, e.g. decode/decompress);
+                # split_expanding_only trades the bound for full laziness
+                # on 1:1 chains (no refs→items resolution step).
                 target = (ctx.target_max_block_size
                           if ctx.enable_dynamic_block_splitting
-                          and can_expand else 0)
+                          and (can_expand or not ctx.split_expanding_only)
+                          else 0)
                 if target:
                     # Dynamic block splitting: each task may yield several
                     # sub-blocks; resolving the outer generator refs is a

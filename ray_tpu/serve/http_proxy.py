@@ -414,16 +414,30 @@ class ThreadedHTTPProxy(_RouterMixin):
             def log_message(self, *a):  # quiet
                 pass
 
+            def _json_reply(self, code: int, body: bytes):
+                # HTTP/1.1 keep-alive: the body MUST be delimited by
+                # Content-Length or the client blocks waiting for EOF.
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _dispatch(self):
                 parsed = urlparse(self.path)
+                # Drain the body FIRST: under keep-alive an unread body
+                # desyncs the connection for the next pipelined request.
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self.close_connection = True  # can't locate body end
+                    self._json_reply(400, b'{"error": "bad content-length"}')
+                    return
+                raw = self.rfile.read(length) if length else b""
                 name = proxy._match(parsed.path)
                 if name is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "no route"}')
+                    self._json_reply(404, b'{"error": "no route"}')
                     return
-                length = int(self.headers.get("Content-Length", 0))
-                raw = self.rfile.read(length) if length else b""
                 payload, wants_stream = _decode_payload(
                     self.command, parsed,
                     {"accept": self.headers.get("Accept", "")}, raw)
@@ -435,17 +449,11 @@ class ThreadedHTTPProxy(_RouterMixin):
                         self._stream_sse(handle, payload)
                         return
                     result = ray_tpu.get(handle.remote(payload), timeout=120)
-                    body = json.dumps({"result": result}).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._json_reply(
+                        200, json.dumps({"result": result}).encode())
                 except Exception as e:
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(
-                        json.dumps({"error": str(e)}).encode()
-                    )
+                    self._json_reply(
+                        500, json.dumps({"error": str(e)}).encode())
 
             def _stream_sse(self, handle, payload):
                 payload = {k: v for k, v in payload.items() if k != "stream"}

@@ -232,7 +232,12 @@ class PB2(PopulationBasedTraining):
             seed=seed)
         self.bounds = dict(hyperparam_bounds or {})
         self.kappa = ucb_kappa
-        self._history: list[tuple[dict, float]] = []  # (config, signed metric)
+        from collections import deque
+
+        # (config, signed metric); _gp_ucb_pick consumes the last ≤64
+        # matching rows, so a bounded window is behavior-identical and
+        # keeps long runs O(1) memory.
+        self._history: deque = deque(maxlen=256)
 
     def on_result(self, trial, result: dict) -> str:
         if result.get(self.metric) is not None:
@@ -279,20 +284,17 @@ class PB2(PopulationBasedTraining):
         if len(obs) < 3:
             pick = cand[0]
         else:
+            from .search import gp_posterior
+
             X = np.asarray([norm(c) for c, _ in obs])
             y = np.asarray([v for _, v in obs])
             y = (y - y.mean()) / max(y.std(), 1e-9)
-            ls, noise = 0.25, 1e-2
-            def k(a, b):
-                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
-                return np.exp(-d2 / (2 * ls * ls))
-            K = k(X, X) + noise * np.eye(len(X))
-            Kinv = np.linalg.inv(K)
-            Ks = k(cand, X)
-            mu = Ks @ Kinv @ y
-            var = np.clip(1.0 - np.einsum(
-                "ij,jk,ik->i", Ks, Kinv, Ks), 1e-9, None)
-            pick = cand[int(np.argmax(mu + self.kappa * np.sqrt(var)))]
+            try:
+                mu, sd = gp_posterior(X, y, cand,
+                                      length_scale=0.25, noise=1e-2)
+                pick = cand[int(np.argmax(mu + self.kappa * sd))]
+            except np.linalg.LinAlgError:
+                pick = cand[0]
         new = dict(base_cfg)
         new.update(denorm(pick))
         return new

@@ -257,6 +257,29 @@ class TPESearcher(Searcher):
         return best_cfg
 
 
+def gp_posterior(X, y, Xc, length_scale: float, noise: float):
+    """RBF-kernel GP posterior mean/std at candidates Xc given (X, y).
+
+    Cholesky-based (stable on near-singular K from duplicate configs);
+    shared by BayesOptSearcher (EI) and PB2's UCB exploit step.
+    """
+    import numpy as np
+
+    def k(a, b):
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-d2 / (2 * length_scale ** 2))
+
+    K = k(X, X) + noise * np.eye(len(X))
+    Ks = k(X, Xc)
+    Kss = np.ones(len(Xc))
+    L = np.linalg.cholesky(K)
+    alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+    mu = Ks.T @ alpha
+    v = np.linalg.solve(L, Ks)
+    var = np.maximum(Kss - (v ** 2).sum(0), 1e-12)
+    return mu, np.sqrt(var)
+
+
 class BayesOptSearcher(Searcher):
     """Gaussian-process + expected-improvement searcher — the role BayesOpt
     /Ax/HEBO integrations play for the reference (`tune/search/bayesopt`),
@@ -315,21 +338,7 @@ class BayesOptSearcher(Searcher):
         return np.asarray(x, float)
 
     def _gp_posterior(self, X, y, Xc):
-        import numpy as np
-
-        def k(a, b):
-            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
-            return np.exp(-d2 / (2 * self.length_scale ** 2))
-
-        K = k(X, X) + self.noise * np.eye(len(X))
-        Ks = k(X, Xc)
-        Kss = np.ones(len(Xc))
-        L = np.linalg.cholesky(K)
-        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
-        mu = Ks.T @ alpha
-        v = np.linalg.solve(L, Ks)
-        var = np.maximum(Kss - (v ** 2).sum(0), 1e-12)
-        return mu, np.sqrt(var)
+        return gp_posterior(X, y, Xc, self.length_scale, self.noise)
 
     def suggest(self, trial_id: str) -> dict:
         import numpy as np
@@ -421,6 +430,13 @@ class ExternalSearcher(Searcher):
     def __init__(self, external, metric: str | None = None,
                  mode: str = "max"):
         self.ext = external
+        if (metric is None and not hasattr(external, "on_trial_complete")
+                and hasattr(external, "tell")):
+            # Without a metric we could never call tell(), silently
+            # degrading an ask/tell optimizer to random search.
+            raise ValueError(
+                "ExternalSearcher(metric=...) is required for ask/tell-"
+                f"style externals like {type(external).__name__}")
         self.metric = metric
         self.sign = 1.0 if mode == "max" else -1.0
         self._asked: dict[str, Any] = {}
@@ -439,6 +455,12 @@ class ExternalSearcher(Searcher):
         if hasattr(self.ext, "on_trial_complete"):
             self.ext.on_trial_complete(trial_id, result)
             return
+        # Always retire the ask (errored/metric-less trials would
+        # otherwise leak _asked entries and stay "running" in the
+        # external's book-keeping).
+        params = self._asked.pop(
+            trial_id, (result or {}).get("config", {}))
         if hasattr(self.ext, "tell") and result and self.metric in result:
-            params = self._asked.pop(trial_id, result.get("config", {}))
             self.ext.tell(params, self.sign * result[self.metric])
+        elif hasattr(self.ext, "tell_failed"):
+            self.ext.tell_failed(params)

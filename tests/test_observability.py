@@ -244,6 +244,14 @@ class TestClusterEvents:
         # paging: after_seq excludes older rows
         later = state.list_cluster_events(after_seq=seqs[0])
         assert all(e["seq"] > seqs[0] for e in later)
+        # forward-cursor paging returns the OLDEST rows after the cursor
+        # (limit slices the head, not the tail) and never skips backlog.
+        page, latest = state.list_cluster_events(
+            after_seq=0, limit=2, return_latest_seq=True)
+        assert [e["seq"] for e in page] == seqs[:2]
+        assert latest >= seqs[-1]
+        page2 = state.list_cluster_events(after_seq=page[-1]["seq"], limit=2)
+        assert [e["seq"] for e in page2] == seqs[2:4]
 
         # dashboard endpoint serves the same trail
         from ray_tpu.dashboard import start_dashboard
