@@ -141,8 +141,10 @@ class GcsServer:
         out = [e for e in self.events if e["seq"] > after]
         # Forward-cursor paging: oldest-first after the cursor, so a
         # consumer advancing after_seq never skips backlog events.
+        # tail=True flips to the newest `limit` rows (dashboard view) so
+        # watchers don't have to transfer the whole ring per poll.
         if limit and limit > 0:
-            out = out[:limit]
+            out = out[-limit:] if p.get("tail") else out[:limit]
         return {"events": out, "latest_seq": self._event_seq}
 
     def publish(self, channel: str, msg: Any) -> None:

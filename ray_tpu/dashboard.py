@@ -90,10 +90,11 @@ class _Handler(BaseHTTPRequestHandler):
             if self.path == "/api/timeline":
                 return self._json(state.timeline())
             if self.path == "/api/events":
-                # Newest window: events_get pages oldest-first, so ask
-                # for everything (limit=0) and keep the tail — the ring
-                # holds ≤10k rows, and a post-mortem wants recent events.
-                return self._json(state.list_cluster_events(limit=0)[-1000:])
+                # Newest window, server-side (a post-mortem wants recent
+                # events; fetching the whole ring per poll would move 10x
+                # the bytes).
+                return self._json(
+                    state.list_cluster_events(limit=1000, tail=True))
             if self.path in ("/api/jobs", "/api/jobs/"):
                 return self._json(ray_tpu.get(
                     self.server.jobs.list.remote(), timeout=30))

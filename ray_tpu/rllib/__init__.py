@@ -16,6 +16,7 @@ from ray_tpu.rllib.env import (
     make_env,
     register_env,
 )
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.multi_agent import (
     MultiAgentCartPole,
@@ -32,6 +33,7 @@ from ray_tpu.rllib.offline import (
 from ray_tpu.rllib.policy import Policy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
+from ray_tpu.rllib.td3 import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
@@ -39,6 +41,7 @@ from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 __all__ = [
     "A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig",
     "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
+    "APPO", "APPOConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",

@@ -1,0 +1,78 @@
+"""APPO: asynchronous PPO — IMPALA's async pipeline + a clipped surrogate.
+
+Parity: `/root/reference/rllib/algorithms/appo/appo.py:1` — APPO is IMPALA
+with the policy-gradient term replaced by PPO's clipped importance-weighted
+surrogate (and optionally a KL penalty toward the behavior policy), so
+stale fragments can't push the policy arbitrarily far per update. The
+async driver loop, backpressure, and V-trace target computation are
+inherited unchanged from `impala.py`; only the jitted loss differs — the
+whole update stays ONE donated device dispatch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        # PPO surrogate clip on the importance ratio (ref: appo.py
+        # clip_param).
+        self.clip_param = 0.3
+        # Optional penalty toward the behavior policy (ref: use_kl_loss /
+        # kl_coeff) — stabilizes very stale fragments.
+        self.use_kl_loss = False
+        self.kl_coeff = 0.2
+
+
+class APPO(IMPALA):
+    """Async sampling actors → central learner with a clipped surrogate."""
+
+    @classmethod
+    def get_default_config(cls) -> APPOConfig:
+        return APPOConfig()
+
+    def _loss(self, params, batch):
+        cfg: APPOConfig = self.config
+        pol = self.policy
+        T, N = batch[sb.REWARDS].shape
+        obs = batch[sb.OBS].reshape((T * N,) + batch[sb.OBS].shape[2:])
+        actions = batch[sb.ACTIONS].reshape(
+            (T * N,) + batch[sb.ACTIONS].shape[2:])
+        logp = pol._logp(params, obs, actions).reshape(T, N)
+        values = pol.value(params, obs).reshape(T, N)
+        last_v = pol.value(params, batch["last_obs"])
+        entropy = jnp.mean(pol._entropy(params, obs))
+        log_rhos = logp - batch[sb.LOGP]
+        rhos = jnp.exp(log_rhos)
+        vs, pg_adv = vtrace(
+            jax.lax.stop_gradient(values), jax.lax.stop_gradient(last_v),
+            jax.lax.stop_gradient(rhos), batch[sb.REWARDS],
+            batch[sb.DONES], batch[sb.TRUNCS], batch[sb.BOOTSTRAP_VALUES],
+            gamma=cfg.gamma, clip_rho=cfg.vtrace_clip_rho_threshold,
+            clip_pg_rho=cfg.vtrace_clip_pg_rho_threshold)
+        # PPO clipped surrogate on the V-trace advantages: the ratio is
+        # trained (unlike IMPALA's -logp * adv), but clipped so one stale
+        # fragment can't move pi(a|s) beyond 1 ± clip_param.
+        adv = jax.lax.stop_gradient(pg_adv)
+        clipped = jnp.clip(rhos, 1.0 - cfg.clip_param, 1.0 + cfg.clip_param)
+        pg_loss = -jnp.mean(jnp.minimum(rhos * adv, clipped * adv))
+        vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        loss = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                - cfg.entropy_coeff * entropy)
+        # KL(behavior || current) estimated from the sampled actions:
+        # E_mu[-log_rho] ≥ 0 in expectation.
+        kl = jnp.mean(-log_rhos)
+        if cfg.use_kl_loss:
+            loss = loss + cfg.kl_coeff * kl
+        return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                      "entropy": entropy, "mean_rho": jnp.mean(rhos),
+                      "kl": kl}
+
+
+APPOConfig.algo_class = APPO

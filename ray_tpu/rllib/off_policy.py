@@ -1,0 +1,81 @@
+"""Shared off-policy driver machinery for continuous-control algorithms.
+
+SAC and TD3/DDPG (ref: rllib/algorithms/{sac,td3,ddpg}) share the whole
+replay-driven sampling contract: uniform random warmup until
+`learning_starts`, jitted action selection after, time-limit handling that
+stores the recorded pre-reset final_obs as next_obs, and per-env episode
+return bookkeeping. One copy here so a fix to the truncation/bootstrap
+subtleties can't silently miss an algorithm.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class OffPolicyDriver:
+    """Mixin for Algorithm subclasses with a replay buffer and a
+    continuous action space. Requires: self.config (train_batch_size,
+    learning_starts), self.buffer, self._key, self._timesteps_total,
+    self.workers, and setup() to have called _setup_continuous_env()."""
+
+    def _setup_continuous_env(self) -> int:
+        """Introspect the env; sets act_dim/act_low/act_high. Returns
+        obs_dim."""
+        env = self.workers.local.env
+        assert not env.action_space.discrete, (
+            f"{type(self).__name__} is for continuous actions")
+        self.act_dim = int(np.prod(env.action_space.shape))
+        self.act_low = float(np.min(env.action_space.low))
+        self.act_high = float(np.max(env.action_space.high))
+        return int(np.prod(env.observation_space.shape))
+
+    def _np_random_actions(self, env):
+        rng = np.random.default_rng(int(self._timesteps_total) + 7)
+        return rng.uniform(self.act_low, self.act_high,
+                           (env.num_envs,) + tuple(
+                               env.action_space.shape or (1,)))
+
+    def _collect_steps(self, act_fn) -> None:
+        """Run ~train_batch_size env steps storing transitions in
+        self.buffer. act_fn(obs_f32, key) -> actions (device or numpy)."""
+        cfg = self.config
+        worker = self.workers.local
+        env = worker.env
+        obs = worker.obs
+        n_steps = max(1, cfg.train_batch_size // env.num_envs)
+        for _ in range(n_steps):
+            self._key, sub = jax.random.split(self._key)
+            if self._timesteps_total < cfg.learning_starts:
+                a = self._np_random_actions(env)
+            else:
+                a = np.asarray(act_fn(jnp.asarray(obs, jnp.float32), sub))
+            next_obs, reward, done, trunc = env.step(a)
+            finished = np.logical_or(done, trunc)
+            # Time-limit handling: a truncated episode's transition
+            # bootstraps through the TRUE successor state the env
+            # recorded before auto-reset, not the reset observation.
+            stored_next = np.where(
+                finished.reshape((-1,) + (1,) * (next_obs.ndim - 1)),
+                env.final_obs, next_obs)
+            self.buffer.add(SampleBatch({
+                sb.OBS: obs.astype(np.float32),
+                sb.ACTIONS: np.asarray(a, np.float32).reshape(
+                    env.num_envs, self.act_dim),
+                sb.REWARDS: reward.astype(np.float32),
+                sb.DONES: done,
+                sb.NEXT_OBS: stored_next.astype(np.float32),
+            }))
+            worker._running_return += reward
+            for i in np.nonzero(finished)[0]:
+                worker.episode_returns.append(
+                    float(worker._running_return[i]))
+                worker._running_return[i] = 0.0
+            obs = next_obs
+            self._timesteps_total += env.num_envs
+        worker.obs = obs

@@ -16,9 +16,9 @@ import optax
 
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.off_policy import OffPolicyDriver
 from ray_tpu.rllib.policy import _init_mlp, _mlp
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
-from ray_tpu.rllib.sample_batch import SampleBatch
 
 LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
 
@@ -40,19 +40,14 @@ class SACConfig(AlgorithmConfig):
         self.update_batch_size = 256
 
 
-class SAC(Algorithm):
+class SAC(OffPolicyDriver, Algorithm):
     @classmethod
     def get_default_config(cls) -> SACConfig:
         return SACConfig()
 
     def setup(self) -> None:
         cfg: SACConfig = self.config
-        env = self.workers.local.env
-        assert not env.action_space.discrete, "SAC is for continuous actions"
-        obs_dim = int(np.prod(env.observation_space.shape))
-        self.act_dim = int(np.prod(env.action_space.shape))
-        self.act_low = float(np.min(env.action_space.low))
-        self.act_high = float(np.max(env.action_space.high))
+        obs_dim = self._setup_continuous_env()
         self.target_entropy = (cfg.target_entropy
                                if cfg.target_entropy is not None
                                else -float(self.act_dim))
@@ -156,37 +151,8 @@ class SAC(Algorithm):
     def training_step(self) -> dict:
         cfg: SACConfig = self.config
         worker = self.workers.local
-        env = worker.env
-        obs = worker.obs
-        n_steps = max(1, cfg.train_batch_size // env.num_envs)
-        for _ in range(n_steps):
-            self._key, sub = jax.random.split(self._key)
-            if self._timesteps_total < cfg.learning_starts:
-                a = self._np_random_actions(env)
-            else:
-                a = np.asarray(self._act(
-                    self.params, jnp.asarray(obs, jnp.float32), sub))
-            next_obs, reward, done, trunc = env.step(a)
-            finished = np.logical_or(done, trunc)
-            stored_next = np.where(
-                finished.reshape((-1,) + (1,) * (next_obs.ndim - 1)),
-                env.final_obs, next_obs)
-            self.buffer.add(SampleBatch({
-                sb.OBS: obs.astype(np.float32),
-                sb.ACTIONS: np.asarray(a, np.float32).reshape(
-                    env.num_envs, self.act_dim),
-                sb.REWARDS: reward.astype(np.float32),
-                sb.DONES: done,
-                sb.NEXT_OBS: stored_next.astype(np.float32),
-            }))
-            worker._running_return += reward
-            for i in np.nonzero(finished)[0]:
-                worker.episode_returns.append(
-                    float(worker._running_return[i]))
-                worker._running_return[i] = 0.0
-            obs = next_obs
-            self._timesteps_total += env.num_envs
-        worker.obs = obs
+        self._collect_steps(
+            lambda obs, key: self._act(self.params, obs, key))
 
         metrics = {}
         if len(self.buffer) >= cfg.learning_starts:
@@ -208,12 +174,5 @@ class SAC(Algorithm):
             "episode_return_mean": m["episode_return_mean"],
             **metrics,
         }
-
-    def _np_random_actions(self, env):
-        rng = np.random.default_rng(int(self._timesteps_total) + 7)
-        return rng.uniform(self.act_low, self.act_high,
-                           (env.num_envs,) + tuple(
-                               env.action_space.shape or (1,)))
-
 
 SACConfig.algo_class = SAC

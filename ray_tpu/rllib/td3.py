@@ -1,0 +1,188 @@
+"""TD3: twin-delayed deep deterministic policy gradient.
+
+Parity: `/root/reference/rllib/algorithms/td3/` (and ddpg/, which TD3
+subsumes — set policy_delay=1, target_noise=0 for plain DDPG). Off-policy
+replay with a deterministic tanh policy and the three TD3 stabilizers:
+twin Q networks (min over the target pair), delayed policy updates, and
+target-policy smoothing (clipped Gaussian noise on the target action).
+
+TPU-first: the critic and (every `policy_delay`-th) actor update are one
+jitted, donated dispatch; the delay is a traced modulo — jnp.where masks
+the actor/target update instead of branching, so a single compiled step
+serves both phases.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.off_policy import OffPolicyDriver
+from ray_tpu.rllib.policy import _init_mlp, _mlp
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size = 100_000
+        self.learning_starts = 1000
+        self.tau = 0.005
+        self.policy_delay = 2          # actor updates every N critic updates
+        self.target_noise = 0.2        # target-policy smoothing sigma
+        self.target_noise_clip = 0.5
+        self.explore_noise = 0.1       # behavior-policy Gaussian sigma
+        self.train_batch_size = 64
+        self.sgd_rounds_per_step = 64
+        self.update_batch_size = 256
+
+
+class TD3(OffPolicyDriver, Algorithm):
+    @classmethod
+    def get_default_config(cls) -> TD3Config:
+        return TD3Config()
+
+    def setup(self) -> None:
+        cfg: TD3Config = self.config
+        obs_dim = self._setup_continuous_env()
+        k = jax.random.key(cfg.env_seed)
+        kpi, kq1, kq2 = jax.random.split(k, 3)
+        H = cfg.model_hiddens
+        self.params = {
+            "pi": _init_mlp(kpi, (obs_dim, *H, self.act_dim)),
+            "q1": _init_mlp(kq1, (obs_dim + self.act_dim, *H, 1),
+                            scale_last=1.0),
+            "q2": _init_mlp(kq2, (obs_dim + self.act_dim, *H, 1),
+                            scale_last=1.0),
+        }
+        self.target = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.env_seed)
+        self._key = jax.random.key(cfg.env_seed + 1)
+        self._n_updates = 0
+        self._act = jax.jit(self._act_impl)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1, 2))
+
+    # ---- deterministic policy ----
+
+    def _mu(self, params, obs):
+        a = jnp.tanh(_mlp(params["pi"], obs))
+        scale = (self.act_high - self.act_low) / 2.0
+        mid = (self.act_high + self.act_low) / 2.0
+        return a * scale + mid
+
+    def _act_impl(self, params, obs, key):
+        a = self._mu(params, obs)
+        noise = self.config.explore_noise * jax.random.normal(key, a.shape)
+        return jnp.clip(a + noise, self.act_low, self.act_high)
+
+    def _q(self, qparams, obs, act):
+        return _mlp(qparams, jnp.concatenate([obs, act], axis=-1))[:, 0]
+
+    # ---- one fused update (critics always, actor+targets masked) ----
+
+    def _update_impl(self, params, opt_state, target, key, batch,
+                     do_policy):
+        cfg: TD3Config = self.config
+
+        # Target action with clipped smoothing noise (TD3 stabilizer #3).
+        noise = jnp.clip(
+            cfg.target_noise * jax.random.normal(
+                key, (batch[sb.OBS].shape[0], self.act_dim)),
+            -cfg.target_noise_clip, cfg.target_noise_clip)
+        a_next = jnp.clip(
+            self._mu(target, batch[sb.NEXT_OBS]) + noise,
+            self.act_low, self.act_high)
+        qt = jnp.minimum(
+            self._q(target["q1"], batch[sb.NEXT_OBS], a_next),
+            self._q(target["q2"], batch[sb.NEXT_OBS], a_next))
+        y = jax.lax.stop_gradient(
+            batch[sb.REWARDS] + cfg.gamma
+            * (1.0 - batch[sb.DONES].astype(jnp.float32)) * qt)
+
+        def loss_fn(params):
+            q1 = self._q(params["q1"], batch[sb.OBS], batch[sb.ACTIONS])
+            q2 = self._q(params["q2"], batch[sb.OBS], batch[sb.ACTIONS])
+            q_loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+            # Deterministic policy gradient through frozen critics.
+            a_pi = self._mu(params, batch[sb.OBS])
+            pi_loss = -jnp.mean(self._q(
+                jax.lax.stop_gradient(params["q1"]), batch[sb.OBS], a_pi))
+            # do_policy masks the actor term (delayed updates): its grads
+            # are zeroed on off-steps, critics train every step.
+            total = q_loss + jnp.where(do_policy, pi_loss, 0.0)
+            return total, (q_loss, pi_loss)
+
+        (_, (q_loss, pi_loss)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        # Freeze the actor on off-steps: zero grads alone still yield a
+        # nonzero Adam step from first-moment memory, so gate the pi
+        # update subtree too (reference skips the actor optimizer step).
+        updates = {**updates, "pi": jax.tree.map(
+            lambda u: jnp.where(do_policy, u, 0.0), updates["pi"])}
+        params = optax.apply_updates(params, updates)
+        # Polyak target update, also delayed to the policy cadence.
+        tau = jnp.where(do_policy, cfg.tau, 0.0)
+        target = jax.tree.map(
+            lambda t, o: (1 - tau) * t + tau * o, target, params)
+        return params, opt_state, target, q_loss, pi_loss
+
+    # ---- sampling + training loop (SAC-shaped off-policy driver) ----
+
+    def training_step(self) -> dict:
+        cfg: TD3Config = self.config
+        worker = self.workers.local
+        self._collect_steps(
+            lambda obs, key: self._act(self.params, obs, key))
+
+        metrics = {}
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.sgd_rounds_per_step):
+                batch = self.buffer.sample(cfg.update_batch_size)
+                dev = {k: jnp.asarray(v) for k, v in batch.items()
+                       if k not in ("weights", "batch_indexes")}
+                self._key, sub = jax.random.split(self._key)
+                self._n_updates += 1
+                do_pi = jnp.asarray(
+                    self._n_updates % cfg.policy_delay == 0)
+                (self.params, self.opt_state, self.target,
+                 q_loss, pi_loss) = self._update(
+                    self.params, self.opt_state, self.target, sub, dev,
+                    do_pi)
+            metrics = {"q_loss": float(q_loss),
+                       "pi_loss": float(pi_loss)}
+        m = worker.metrics()
+        return {
+            "timesteps_total": self._timesteps_total,
+            "episode_return_mean": m["episode_return_mean"],
+            **metrics,
+        }
+
+
+TD3Config.algo_class = TD3
+
+
+class DDPGConfig(TD3Config):
+    """DDPG = TD3 minus the stabilizers (ref: rllib/algorithms/ddpg/)."""
+
+    def __init__(self):
+        super().__init__()
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        self.target_noise_clip = 0.0
+
+
+class DDPG(TD3):
+    @classmethod
+    def get_default_config(cls) -> DDPGConfig:
+        return DDPGConfig()
+
+
+DDPGConfig.algo_class = DDPG

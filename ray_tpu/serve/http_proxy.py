@@ -429,6 +429,8 @@ class ThreadedHTTPProxy(_RouterMixin):
                 # desyncs the connection for the next pipelined request.
                 try:
                     length = int(self.headers.get("Content-Length", 0))
+                    if length < 0:  # read(-N) would block until EOF
+                        raise ValueError(length)
                 except ValueError:
                     self.close_connection = True  # can't locate body end
                     self._json_reply(400, b'{"error": "bad content-length"}')

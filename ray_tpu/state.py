@@ -63,13 +63,15 @@ def list_actors(*, state: str | None = None) -> list[dict]:
 
 def list_cluster_events(after_seq: int = 0,
                         limit: int = 1000,
-                        return_latest_seq: bool = False):
+                        return_latest_seq: bool = False,
+                        tail: bool = False):
     """Structured cluster event log (ref: src/ray/util/event.h +
     dashboard/modules/event): node joins/deaths, actor lifecycle, OOM
     kills — the durable post-mortem trail. Page forward by passing the
     max returned seq (or `latest_seq` via return_latest_seq=True) back
-    as after_seq."""
-    resp = _call_gcs("events_get", {"after_seq": after_seq, "limit": limit})
+    as after_seq; tail=True returns the newest `limit` rows instead."""
+    resp = _call_gcs("events_get", {"after_seq": after_seq, "limit": limit,
+                                    "tail": tail})
     if return_latest_seq:
         return resp["events"], resp.get("latest_seq", 0)
     return resp["events"]
