@@ -149,6 +149,20 @@ class Config:
     # fsync each WAL append (survives machine crash, not just process kill).
     gcs_wal_fsync: bool = False
 
+    # --- background loop cadences + stock RPC deadlines (promoted hot
+    #     literals, ref: ray_config_def.h's timer section) ---
+    # Idle-worker reap sweep cadence in the raylet.
+    raylet_idle_reap_interval_s: float = 5.0
+    # Raylet log-directory scan cadence (log streaming to drivers).
+    raylet_log_scan_interval_s: float = 0.5
+    # Worker profile-span flush cadence to the GCS.
+    worker_profile_flush_interval_s: float = 1.0
+    # Stock deadline for intra-cluster control RPCs that have no
+    # tighter site-specific bound.
+    rpc_default_timeout_s: float = 10.0
+    # GCS (re)connect + node re-registration deadline.
+    gcs_register_timeout_s: float = 30.0
+
     # --- train gang rendezvous ---
     # jax.distributed.initialize connection window for a worker gang.
     train_rendezvous_timeout_s: float = 300.0

@@ -382,7 +382,7 @@ class GcsServer:
             try:
                 r = await node_conn.call("pg_reserve", {
                     "pg_id": pg_id, "bundle_index": i, "resources": res,
-                }, timeout=10.0)
+                }, timeout=self.config.rpc_default_timeout_s)
             except Exception as e:
                 r = {"ok": False, "error": repr(e)}
             if not r.get("ok"):
@@ -393,7 +393,7 @@ class GcsServer:
                         try:
                             await c2.call("pg_return", {
                                 "pg_id": pg_id, "bundle_index": j,
-                            }, timeout=10.0)
+                            }, timeout=self.config.rpc_default_timeout_s)
                         except Exception:
                             pass
                 return {"ok": False, "error": r.get("error", "reserve failed")}
@@ -428,7 +428,7 @@ class GcsServer:
                 try:
                     await node_conn.call("pg_return", {
                         "pg_id": p["pg_id"], "bundle_index": b["index"],
-                    }, timeout=10.0)
+                    }, timeout=self.config.rpc_default_timeout_s)
                 except Exception:
                     pass
             # Keep the GCS view in sync (mirror of pg_create's decrement).

@@ -258,7 +258,7 @@ class Raylet:
                 if vv != self._view_seen:
                     delta = await self.gcs.call(
                         "get_view_delta", {"since": self._view_seen},
-                        timeout=10.0)
+                        timeout=self.config.rpc_default_timeout_s)
                     for nid, nview in delta["nodes"].items():
                         nview["address"] = tuple(nview["address"])
                         self.cluster_view[nid] = nview
@@ -269,7 +269,8 @@ class Raylet:
                 logger.warning("GCS unreachable; retrying connect")
                 try:
                     self.gcs = await rpc.connect(
-                        *self.gcs_address, timeout=30.0,
+                        *self.gcs_address,
+                        timeout=self.config.gcs_register_timeout_s,
                         notify_handler=self._gcs_notify,
                     )
                     await self.gcs.call("register_node",
@@ -420,7 +421,7 @@ class Raylet:
 
     async def _reap_idle_loop(self) -> None:
         while not self._shutdown:
-            await asyncio.sleep(5.0)
+            await asyncio.sleep(self.config.raylet_idle_reap_interval_s)
             now = time.monotonic()
             excess = [
                 h for h in self.workers.values()
@@ -474,7 +475,7 @@ class Raylet:
         log_dir = os.path.join(self.session_dir, "logs")
         node_hex = NodeID(self.node_id).hex()[:8]
         while not self._shutdown:
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(self.config.raylet_log_scan_interval_s)
             try:
                 names = [n for n in os.listdir(log_dir)
                          if n.startswith("worker-")]
@@ -530,7 +531,7 @@ class Raylet:
                                 "worker": worker_hex,
                                 "lines": lines[i:i + 200],
                             },
-                        }, timeout=10.0)
+                        }, timeout=self.config.rpc_default_timeout_s)
                     except Exception:
                         break
 
@@ -1193,7 +1194,8 @@ class Raylet:
                 # we keep polling the directory on later store_get rounds.
                 try:
                     await self.gcs.call("obj_request_recovery", {
-                        "object_ids": [obj.binary()]}, timeout=10.0)
+                        "object_ids": [obj.binary()]},
+                        timeout=self.config.rpc_default_timeout_s)
                 except Exception:
                     pass
                 return False
@@ -1210,7 +1212,7 @@ class Raylet:
                     info = await peer.call(
                         "obj_info",
                         {"object_id": obj.binary(), "want_serve": True},
-                        timeout=10.0)
+                        timeout=self.config.rpc_default_timeout_s)
                     if info is None:
                         continue
                     if info.get("busy"):

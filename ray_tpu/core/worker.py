@@ -258,17 +258,18 @@ class Worker:
 
         source = f"worker:{WorkerID(self.worker_id).hex()[:8]}"
         while not self._exit.is_set():
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(
+                self.config.worker_profile_flush_interval_s)
             try:
                 events = profiling.drain_events()
                 if events:
                     await self.gcs.call("profile_add", {"events": events},
-                                        timeout=10.0)
+                                        timeout=self.config.rpc_default_timeout_s)
                 rows = profiling.metrics_snapshot()
                 if rows:
                     await self.gcs.call(
                         "metrics_push", {"source": source, "rows": rows},
-                        timeout=10.0)
+                        timeout=self.config.rpc_default_timeout_s)
             except Exception:
                 pass
 

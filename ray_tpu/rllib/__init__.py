@@ -17,6 +17,12 @@ from ray_tpu.rllib.env import (
     register_env,
 )
 from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    Connector,
+    ConnectorPipeline,
+    MeanStdFilter,
+)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.multi_agent import (
     MultiAgentCartPole,
@@ -42,6 +48,7 @@ __all__ = [
     "A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig",
     "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
     "APPO", "APPOConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
+    "Connector", "ConnectorPipeline", "MeanStdFilter", "ClipActions",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",

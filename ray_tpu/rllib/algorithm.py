@@ -35,6 +35,10 @@ class AlgorithmConfig:
         # shared Nature-CNN torso for [H,W,C] pixel observations
         # (rllib/models.py — ref: rllib/models/catalog.py vision nets).
         self.model_conv: str | None = None
+        # Connectors (ref: rllib/connectors + utils/filter.py):
+        # "mean_std" normalizes obs with fleet-synced running moments.
+        self.observation_filter: str | None = None
+        self.clip_actions = False
 
     def environment(self, env, *, seed: int = 0) -> "AlgorithmConfig":
         self.env = env
@@ -43,13 +47,19 @@ class AlgorithmConfig:
 
     def rollouts(self, *, num_rollout_workers: int | None = None,
                  num_envs_per_worker: int | None = None,
-                 rollout_fragment_length: int | None = None) -> "AlgorithmConfig":
+                 rollout_fragment_length: int | None = None,
+                 observation_filter: str | None = None,
+                 clip_actions: bool | None = None) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if num_envs_per_worker is not None:
             self.num_envs_per_worker = num_envs_per_worker
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if observation_filter is not None:
+            self.observation_filter = observation_filter
+        if clip_actions is not None:
+            self.clip_actions = clip_actions
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -82,6 +92,8 @@ class Algorithm:
             hiddens=tuple(config.model_hiddens),
             conv=config.model_conv,
             seed=config.env_seed,
+            observation_filter=config.observation_filter,
+            clip_actions=config.clip_actions,
         )
         self._timesteps_total = 0
         self.setup()
@@ -100,6 +112,9 @@ class Algorithm:
         t0 = time.perf_counter()
         info = self.training_step()
         self.iteration += 1
+        # Fold per-sampler obs-filter deltas into the fleet state once
+        # per iteration (no-op unless observation_filter is set).
+        self.workers.sync_filters()
         metrics = self.workers.metrics()
         returns = [m["episode_return_mean"] for m in metrics
                    if m["episode_return_mean"] is not None]

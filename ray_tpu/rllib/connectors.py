@@ -1,0 +1,166 @@
+"""Connectors: obs/action transform pipelines between env and policy.
+
+Parity: `/root/reference/rllib/connectors/` (agent/action connector
+pipelines) and `rllib/utils/filter.py` (MeanStdFilter) — the pieces that
+sit between raw env observations and the policy, and between policy
+actions and env.step. Stateless transforms are plain callables; the
+stateful MeanStdFilter carries Welford running moments that a WorkerSet
+periodically merges across samplers (ref: rllib/utils/filter_manager.py),
+so every worker normalizes with (approximately) the fleet-wide statistics.
+
+Stored batches hold the TRANSFORMED observations — the learner must see
+exactly what the policy saw — and the RAW policy actions (clipping is an
+env-boundary concern; logp must match the sampled action).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Connector:
+    """A transform in the env↔policy path. Stateless by default."""
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def update(self, x: np.ndarray) -> None:
+        """Observe a batch (stateful connectors only)."""
+
+    def get_state(self):
+        return None
+
+    def set_state(self, state) -> None:
+        pass
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, connectors: list[Connector]):
+        self.connectors = list(connectors)
+
+    def __call__(self, x):
+        for c in self.connectors:
+            x = c(x)
+        return x
+
+    def update(self, x) -> None:
+        # Each stage observes its own INPUT distribution.
+        for c in self.connectors:
+            c.update(x)
+            x = c(x)
+
+    def get_state(self):
+        return [c.get_state() for c in self.connectors]
+
+    def set_state(self, state) -> None:
+        for c, s in zip(self.connectors, state):
+            c.set_state(s)
+
+
+class MeanStdFilter(Connector):
+    """Per-feature running normalization: (x - mean) / std.
+
+    Welford moments over every observed batch; states from parallel
+    samplers merge exactly (count-weighted), so periodic WorkerSet syncs
+    converge all workers onto fleet statistics.
+    """
+
+    def __init__(self, shape: tuple[int, ...], clip: float = 10.0):
+        self.shape = tuple(shape)
+        self.clip = clip
+        self.count = 0.0
+        self.mean = np.zeros(self.shape, np.float64)
+        self.m2 = np.zeros(self.shape, np.float64)
+        # Moments accumulated since the last pop_delta() — the unit of
+        # cross-worker sync (merging full states repeatedly would count
+        # shared history once per worker per round).
+        self._d_count = 0.0
+        self._d_mean = np.zeros(self.shape, np.float64)
+        self._d_m2 = np.zeros(self.shape, np.float64)
+
+    @staticmethod
+    def _accumulate(count, mean, m2, x):
+        n = x.shape[0]
+        b_mean = x.mean(axis=0)
+        b_m2 = ((x - b_mean) ** 2).sum(axis=0)
+        delta = b_mean - mean
+        tot = count + n
+        mean = mean + delta * (n / tot)
+        m2 = m2 + b_m2 + delta ** 2 * (count * n / tot)
+        return tot, mean, m2
+
+    def update(self, x: np.ndarray) -> None:
+        x = np.asarray(x, np.float64).reshape((-1,) + self.shape)
+        if x.shape[0] == 0:
+            return
+        self.count, self.mean, self.m2 = self._accumulate(
+            self.count, self.mean, self.m2, x)
+        self._d_count, self._d_mean, self._d_m2 = self._accumulate(
+            self._d_count, self._d_mean, self._d_m2, x)
+
+    def pop_delta(self) -> dict:
+        """Moments observed since the last pop; resets the delta."""
+        out = {"count": self._d_count, "mean": self._d_mean.copy(),
+               "m2": self._d_m2.copy()}
+        self._d_count = 0.0
+        self._d_mean = np.zeros(self.shape, np.float64)
+        self._d_m2 = np.zeros(self.shape, np.float64)
+        return out
+
+    def _std(self) -> np.ndarray:
+        var = self.m2 / max(self.count - 1, 1.0)
+        return np.sqrt(np.maximum(var, 1e-8))
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        if self.count < 2:
+            return np.asarray(x, np.float32)
+        out = (np.asarray(x, np.float64) - self.mean) / self._std()
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def get_state(self):
+        return {"count": self.count, "mean": self.mean.copy(),
+                "m2": self.m2.copy()}
+
+    def set_state(self, state) -> None:
+        self.count = float(state["count"])
+        self.mean = np.asarray(state["mean"], np.float64).copy()
+        self.m2 = np.asarray(state["m2"], np.float64).copy()
+
+    @staticmethod
+    def merged_state(states: list[dict]) -> dict:
+        """Exact count-weighted merge of Welford states (Chan et al.)."""
+        states = [s for s in states if s and s["count"] > 0]
+        if not states:
+            return {"count": 0.0, "mean": 0.0, "m2": 0.0}
+        out = {k: np.array(states[0][k], np.float64, copy=True)
+               if k != "count" else float(states[0][k])
+               for k in ("count", "mean", "m2")}
+        for s in states[1:]:
+            n1, n2 = out["count"], float(s["count"])
+            tot = n1 + n2
+            delta = np.asarray(s["mean"]) - out["mean"]
+            out["mean"] = out["mean"] + delta * (n2 / tot)
+            out["m2"] = (out["m2"] + np.asarray(s["m2"])
+                         + delta ** 2 * (n1 * n2 / tot))
+            out["count"] = tot
+        return out
+
+
+class ClipActions(Connector):
+    """Clip policy actions into the env's bounds at the env boundary
+    (ref: rllib clip_actions). The batch keeps the raw action."""
+
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def __call__(self, a: np.ndarray) -> np.ndarray:
+        return np.clip(a, self.low, self.high)
+
+
+def build_obs_pipeline(spec: str | None, obs_shape) -> ConnectorPipeline | None:
+    """Config-string catalog (ref: algorithm_config.observation_filter)."""
+    if spec in (None, "none", "NoFilter"):
+        return None
+    if spec in ("mean_std", "MeanStdFilter"):
+        return ConnectorPipeline([MeanStdFilter(tuple(obs_shape))])
+    raise ValueError(f"unknown observation_filter {spec!r}")

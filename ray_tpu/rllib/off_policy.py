@@ -48,14 +48,21 @@ class OffPolicyDriver:
         worker = self.workers.local
         env = worker.env
         obs = worker.obs
+        filt = worker.obs_filter          # connectors (may be None)
+        clip = worker.action_connector
         n_steps = max(1, cfg.train_batch_size // env.num_envs)
         for _ in range(n_steps):
             self._key, sub = jax.random.split(self._key)
+            obs_in = obs.astype(np.float32)
+            if filt is not None:
+                filt.update(obs)
+                obs_in = filt(obs)
             if self._timesteps_total < cfg.learning_starts:
                 a = self._np_random_actions(env)
             else:
-                a = np.asarray(act_fn(jnp.asarray(obs, jnp.float32), sub))
-            next_obs, reward, done, trunc = env.step(a)
+                a = np.asarray(act_fn(jnp.asarray(obs_in), sub))
+            env_a = clip(a) if clip is not None else a
+            next_obs, reward, done, trunc = env.step(env_a)
             finished = np.logical_or(done, trunc)
             # Time-limit handling: a truncated episode's transition
             # bootstraps through the TRUE successor state the env
@@ -63,9 +70,16 @@ class OffPolicyDriver:
             stored_next = np.where(
                 finished.reshape((-1,) + (1,) * (next_obs.ndim - 1)),
                 env.final_obs, next_obs)
+            if filt is not None:
+                # The learner replays what the policy would see. next-obs
+                # uses current stats without update (its un-filtered form
+                # is observed as next step's obs, or never, if reset).
+                stored_next = filt(stored_next)
             self.buffer.add(SampleBatch({
-                sb.OBS: obs.astype(np.float32),
-                sb.ACTIONS: np.asarray(a, np.float32).reshape(
+                sb.OBS: obs_in.astype(np.float32),
+                # Store the EXECUTED action: off-policy critics evaluate
+                # Q(s, a) for the action that produced r and s'.
+                sb.ACTIONS: np.asarray(env_a, np.float32).reshape(
                     env.num_envs, self.act_dim),
                 sb.REWARDS: reward.astype(np.float32),
                 sb.DONES: done,

@@ -28,6 +28,8 @@ class RolloutWorker:
     def __init__(self, env: Any, *, num_envs: int = 1, seed: int = 0,
                  hiddens=(64, 64), conv: str | None = None,
                  rollout_fragment_length: int = 64,
+                 observation_filter: str | None = None,
+                 clip_actions: bool = False,
                  jax_platform: str | None = None):
         # Remote samplers run their small policy MLP on host CPU: per-step
         # inference on tiny batches would be dominated by TPU dispatch
@@ -41,6 +43,14 @@ class RolloutWorker:
             hiddens=hiddens, conv=conv, seed=seed,
         )
         self.fragment = rollout_fragment_length
+        from ray_tpu.rllib.connectors import ClipActions, build_obs_pipeline
+
+        self.obs_filter = build_obs_pipeline(
+            observation_filter, self.env.observation_space.shape)
+        self.action_connector = (
+            ClipActions(float(np.min(self.env.action_space.low)),
+                        float(np.max(self.env.action_space.high)))
+            if clip_actions and not self.env.action_space.discrete else None)
         self.key = jax.random.key(seed)
         self.obs = self.env.reset()
         self.episode_returns: list[float] = []
@@ -55,8 +65,12 @@ class RolloutWorker:
         cols = {
             # Keep the env's obs dtype: pixel envs hand out uint8 frames
             # (4x smaller batches); the policy normalizes on device.
-            sb.OBS: np.zeros((T, N) + self.env.observation_space.shape,
-                             self.env.observation_space.dtype),
+            # A MeanStdFilter emits float32 (batches store what the
+            # policy saw).
+            sb.OBS: np.zeros(
+                (T, N) + self.env.observation_space.shape,
+                np.float32 if self.obs_filter
+                else self.env.observation_space.dtype),
             sb.ACTIONS: None,
             sb.REWARDS: np.zeros((T, N), np.float32),
             sb.DONES: np.zeros((T, N), bool),
@@ -67,24 +81,36 @@ class RolloutWorker:
         }
         for t in range(T):
             self.key, sub = jax.random.split(self.key)
-            actions, logp, vf = self.policy.compute_actions(self.obs, sub)
-            cols[sb.OBS][t] = self.obs
+            obs_in = self.obs
+            if self.obs_filter is not None:
+                self.obs_filter.update(obs_in)
+                obs_in = self.obs_filter(obs_in)
+            actions, logp, vf = self.policy.compute_actions(obs_in, sub)
+            cols[sb.OBS][t] = obs_in
             if cols[sb.ACTIONS] is None:
                 cols[sb.ACTIONS] = np.zeros((T,) + actions.shape,
                                             actions.dtype)
+            # Store the RAW sampled action (logp must match); clip only
+            # at the env boundary.
             cols[sb.ACTIONS][t] = actions
             cols[sb.LOGP][t] = logp
             cols[sb.VF_PREDS][t] = vf
-            self.obs, reward, done, trunc = self.env.step(actions)
+            env_actions = (self.action_connector(actions)
+                           if self.action_connector else actions)
+            self.obs, reward, done, trunc = self.env.step(env_actions)
             cols[sb.REWARDS][t] = reward
             cols[sb.DONES][t] = done
             cols[sb.TRUNCS][t] = trunc
             if trunc.any():
                 # Bootstrap truncated sub-envs through the value of the
                 # PRE-reset terminal obs (env.final_obs), not the reset obs.
+                # Filtered with current stats, not update()d — the next
+                # fragment's first step observes the reset obs instead.
                 self.key, sub = jax.random.split(self.key)
-                _, _, vf_fin = self.policy.compute_actions(
-                    self.env.final_obs, sub)
+                fin = self.env.final_obs
+                if self.obs_filter is not None:
+                    fin = self.obs_filter(fin)
+                _, _, vf_fin = self.policy.compute_actions(fin, sub)
                 cols[sb.BOOTSTRAP_VALUES][t] = np.where(trunc, vf_fin, 0.0)
             self._running_return += reward
             finished = np.logical_or(done, trunc)
@@ -93,13 +119,30 @@ class RolloutWorker:
                 self._running_return[i] = 0.0
         # Bootstrap values for the state after the fragment.
         self.key, sub = jax.random.split(self.key)
-        _, _, last_vf = self.policy.compute_actions(self.obs, sub)
+        last_in = (self.obs_filter(self.obs)
+                   if self.obs_filter is not None else self.obs)
+        _, _, last_vf = self.policy.compute_actions(last_in, sub)
         batch = SampleBatch(cols)
         batch["last_values"] = last_vf
         # Off-policy learners (IMPALA) recompute the bootstrap value with
-        # CURRENT params on the learner — ship the raw obs too.
-        batch["last_obs"] = self.obs.copy()
+        # CURRENT params on the learner — ship the obs (as the policy
+        # would see it) too.
+        batch["last_obs"] = np.asarray(last_in).copy()
         return batch
+
+    def get_filter_state(self):
+        return (self.obs_filter.get_state()
+                if self.obs_filter is not None else None)
+
+    def set_filter_state(self, state) -> None:
+        if self.obs_filter is not None and state is not None:
+            self.obs_filter.set_state(state)
+
+    def pop_filter_delta(self):
+        if self.obs_filter is None:
+            return None
+        return [c.pop_delta() if hasattr(c, "pop_delta") else None
+                for c in self.obs_filter.connectors]
 
     def metrics(self, window: int = 100) -> dict:
         recent = self.episode_returns[-window:]
@@ -115,12 +158,16 @@ class WorkerSet:
 
     def __init__(self, env, *, num_workers: int = 0, num_envs_per_worker: int = 1,
                  rollout_fragment_length: int = 64, hiddens=(64, 64),
-                 conv: str | None = None, seed: int = 0):
+                 conv: str | None = None, seed: int = 0,
+                 observation_filter: str | None = None,
+                 clip_actions: bool = False):
         self.local = RolloutWorker(
             env, num_envs=num_envs_per_worker, seed=seed, hiddens=hiddens,
             conv=conv, rollout_fragment_length=rollout_fragment_length,
+            observation_filter=observation_filter, clip_actions=clip_actions,
         )
         self.remote_workers = []
+        self._master_filter = None   # fleet-wide MeanStdFilter state
         if num_workers > 0:
             actor_cls = ray_tpu.remote(RolloutWorker)
             self.remote_workers = [
@@ -128,6 +175,8 @@ class WorkerSet:
                     env, num_envs=num_envs_per_worker, seed=seed + 1 + i,
                     hiddens=hiddens, conv=conv,
                     rollout_fragment_length=rollout_fragment_length,
+                    observation_filter=observation_filter,
+                    clip_actions=clip_actions,
                     jax_platform="cpu",
                 )
                 for i in range(num_workers)
@@ -149,6 +198,28 @@ class WorkerSet:
         if not self.remote_workers:
             return [self.local.metrics()]
         return ray_tpu.get([w.metrics.remote() for w in self.remote_workers])
+
+    def sync_filters(self) -> None:
+        """Fold every sampler's since-last-sync filter DELTA into one
+        master state and push it back, so all workers normalize with
+        fleet-wide statistics and no observation is ever counted twice
+        (ref: rllib/utils/filter_manager.py)."""
+        if self.local.obs_filter is None:
+            return
+        from ray_tpu.rllib.connectors import MeanStdFilter
+
+        deltas = [self.local.pop_filter_delta()]
+        if self.remote_workers:
+            deltas += ray_tpu.get([w.pop_filter_delta.remote()
+                                   for w in self.remote_workers])
+        if self._master_filter is None:
+            self._master_filter = {"count": 0.0, "mean": 0.0, "m2": 0.0}
+        self._master_filter = MeanStdFilter.merged_state(
+            [self._master_filter] + [d[0] for d in deltas if d])
+        self.local.set_filter_state([self._master_filter])
+        if self.remote_workers:
+            ray_tpu.get([w.set_filter_state.remote([self._master_filter])
+                         for w in self.remote_workers])
 
     def stop(self) -> None:
         for w in self.remote_workers:

@@ -470,7 +470,8 @@ class TestTD3:
 
         cfg = (TD3Config()
                .environment("Pendulum-v1", seed=0)
-               .rollouts(num_envs_per_worker=4)
+               .rollouts(num_envs_per_worker=4,
+                         observation_filter="mean_std", clip_actions=True)
                .training(learning_starts=128, sgd_rounds_per_step=4))
         algo = cfg.build()
         res = None
@@ -478,6 +479,9 @@ class TestTD3:
             res = algo.train()
         assert np.isfinite(res.get("q_loss", 0.0))
         assert algo._n_updates > 0
+        # The off-policy driver feeds the filter (it would silently stay
+        # empty if _collect_steps bypassed connectors).
+        assert algo.workers.local.obs_filter.connectors[0].count > 0
         algo.stop()
 
     def test_ddpg_is_td3_without_stabilizers(self, cluster):
@@ -514,6 +518,66 @@ class TestTD3:
             if best > -600:
                 break
         assert best > -600, f"TD3 did not improve: best={best}"
+        algo.stop()
+
+
+class TestConnectors:
+    def test_mean_std_filter_matches_numpy(self):
+        from ray_tpu.rllib import MeanStdFilter
+
+        rng = np.random.default_rng(0)
+        xs = rng.normal(3.0, 2.5, (500, 4)).astype(np.float32)
+        f = MeanStdFilter((4,))
+        for i in range(0, 500, 50):
+            f.update(xs[i:i + 50])
+        np.testing.assert_allclose(f.mean, xs.mean(0), rtol=1e-6)
+        out = f(xs)
+        assert abs(out.mean()) < 0.05 and abs(out.std() - 1.0) < 0.05
+
+    def test_delta_sync_counts_each_observation_once(self):
+        """Two workers' deltas merged into a master must equal the stats
+        of the union — and repeated syncs must not re-count history."""
+        from ray_tpu.rllib import MeanStdFilter
+
+        rng = np.random.default_rng(1)
+        a, b = rng.normal(0, 1, (100, 3)), rng.normal(5, 2, (140, 3))
+        fa, fb = MeanStdFilter((3,)), MeanStdFilter((3,))
+        fa.update(a)
+        fb.update(b)
+        master = MeanStdFilter.merged_state(
+            [fa.pop_delta(), fb.pop_delta()])
+        both = np.concatenate([a, b])
+        assert master["count"] == 240
+        np.testing.assert_allclose(master["mean"], both.mean(0), rtol=1e-9)
+        # Second sync round with no new data: deltas are empty, master
+        # unchanged (the double-count failure mode of full-state merges).
+        master2 = MeanStdFilter.merged_state(
+            [master, fa.pop_delta(), fb.pop_delta()])
+        assert master2["count"] == 240
+
+    def test_ppo_with_filter_and_clip_on_pendulum(self, cluster):
+        """End to end: filtered obs land in the batch, raw actions are
+        stored while the env sees clipped ones, and remote workers
+        converge onto the fleet filter state after sync."""
+        import ray_tpu
+        from ray_tpu.rllib import PPOConfig
+
+        cfg = (PPOConfig()
+               .environment("Pendulum-v1", seed=0)
+               .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                         rollout_fragment_length=16,
+                         observation_filter="mean_std", clip_actions=True)
+               .training(num_sgd_iter=2, sgd_minibatch_size=32))
+        algo = cfg.build()
+        res = algo.train()
+        assert np.isfinite(res["total_loss"])
+        # After sync_filters (called by train), local + remote agree.
+        local_state = algo.workers.local.get_filter_state()[0]
+        remote_state = ray_tpu.get(
+            algo.workers.remote_workers[0].get_filter_state.remote())[0]
+        assert local_state["count"] == remote_state["count"] > 0
+        np.testing.assert_allclose(local_state["mean"],
+                                   remote_state["mean"])
         algo.stop()
 
 
