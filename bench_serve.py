@@ -31,6 +31,8 @@ def main() -> None:
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--prompt-len", type=int, default=48)
     ap.add_argument("--n-slots", type=int, default=8)
+    ap.add_argument("--decode-block", type=int, default=16,
+                    help="fused decode window: tokens per dispatch")
     ap.add_argument("--bf16", action="store_true",
                     help="serve bf16 weights (halves decode HBM traffic)")
     ap.add_argument("--json-out", default=None)
@@ -57,14 +59,16 @@ def main() -> None:
             lambda a: a.astype(jnp.bfloat16)
             if a.dtype == jnp.float32 else a,
             gpt.init_params(cfg, jax.random.key(0)))
-    engine = LLMEngine(cfg, params, n_slots=args.n_slots, max_len=1024)
+    engine = LLMEngine(cfg, params, n_slots=args.n_slots, max_len=1024,
+                       decode_block=args.decode_block)
     engine.start()
     rng = np.random.default_rng(0)
 
-    # Warm the prefill bucket + decode compile.
+    # Warm the prefill bucket + every decode-window size the measured
+    # requests will hit (a full-length request walks the whole k ladder).
     warm = engine.submit(
         list(rng.integers(0, cfg.vocab_size, args.prompt_len)),
-        max_tokens=4)
+        max_tokens=args.max_tokens)
     warm.done.wait(600)
 
     results = []

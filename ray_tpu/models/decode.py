@@ -109,14 +109,10 @@ def prefill(cfg: GPTConfig, params, tokens, cache, slot, length):
     return last, {"k": new_k, "v": new_v}
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
-def decode_step(cfg: GPTConfig, params, tokens, cache, positions):
-    """One token for every slot. tokens: [B] int32 (the slot's current
-    token); positions: [B] (where that token sits). Inactive slots simply
-    produce garbage logits the engine ignores — shapes never change.
-
-    → (logits [B, V] fp32, updated cache).
-    """
+def _decode_once(cfg: GPTConfig, params, tokens, cache, positions):
+    """Shared single-token forward: all slots advance one position.
+    → (logits [B, V] fp32, updated cache). Traced inside decode_step and
+    inside decode_multi's step scan."""
     B = tokens.shape[0]
     T = cache["k"].shape[2]
     x = params["wte"].astype(cfg.dtype)[tokens][:, None, :]  # [B, 1, D]
@@ -151,6 +147,47 @@ def decode_step(cfg: GPTConfig, params, tokens, cache, positions):
         body, x, (stacked, cache["k"], cache["v"]))
     logits = _head(params, cfg, x)[:, 0]  # [B, V]
     return logits, {"k": new_k, "v": new_v}
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def decode_step(cfg: GPTConfig, params, tokens, cache, positions):
+    """One token for every slot. tokens: [B] int32 (the slot's current
+    token); positions: [B] (where that token sits). Inactive slots simply
+    produce garbage logits the engine ignores — shapes never change.
+
+    → (logits [B, V] fp32, updated cache).
+    """
+    return _decode_once(cfg, params, tokens, cache, positions)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(3,))
+def decode_multi(cfg: GPTConfig, params, tokens, cache, positions,
+                 n_steps: int, temps, key):
+    """`n_steps` fused decode steps with ON-DEVICE sampling: one dispatch +
+    one host transfer per window instead of per token. This is the
+    latency-hiding move for serving — each decode_step round trip costs a
+    full host↔device RTT (hundreds of ms over a remote-dispatch link,
+    dwarfing the ~ms of chip compute per 1B-class token), so batching k
+    steps cuts per-token overhead by k.
+
+    temps: [B] float32 per-slot sampling temperature (0 = greedy).
+    → (tokens_out [n_steps, B] int32, updated cache). The engine trims
+    each slot's emitted tokens host-side (eos / max_tokens mid-window).
+    """
+
+    def step(carry, _):
+        toks, pos, cache, key = carry
+        logits, cache = _decode_once(cfg, params, toks, cache, pos)
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = jax.random.categorical(
+            sub, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+        nxt = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+        return (nxt, pos + 1, cache, key), nxt
+
+    (_, _, cache, _), out = jax.lax.scan(
+        step, (tokens, positions, cache, key), None, length=n_steps)
+    return out, cache
 
 
 def sample_token(logits, *, temperature: float = 0.0, top_k: int = 0,

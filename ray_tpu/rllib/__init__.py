@@ -17,6 +17,7 @@ from ray_tpu.rllib.env import (
     register_env,
 )
 from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.es import ES, ESConfig
 from ray_tpu.rllib.connectors import (
     ClipActions,
     Connector,
@@ -24,6 +25,7 @@ from ray_tpu.rllib.connectors import (
     MeanStdFilter,
 )
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.marwil import BC, MARWIL
 from ray_tpu.rllib.multi_agent import (
     MultiAgentCartPole,
     MultiAgentEnv,
@@ -49,6 +51,7 @@ __all__ = [
     "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
     "APPO", "APPOConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
     "Connector", "ConnectorPipeline", "MeanStdFilter", "ClipActions",
+    "BC", "MARWIL", "ES", "ESConfig",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",

@@ -1,8 +1,11 @@
 """DQN: off-policy Q-learning with replay + target network.
 
 Parity: `/root/reference/rllib/algorithms/dqn/` (double-DQN target, epsilon-
-greedy exploration schedule, prioritized replay, target-network sync). The
-Q update is a single jitted step with donated params.
+greedy exploration schedule, prioritized replay, target-network sync, and
+the `num_atoms > 1` distributional C51 head with categorical projection —
+ref: dqn/dqn_torch_policy.py QLoss). The update is a single jitted step
+with donated params; the C51 projection is one-hot matmuls (static shapes,
+no scatter) so XLA maps it onto the MXU.
 """
 
 from __future__ import annotations
@@ -32,6 +35,11 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_final = 0.05
         self.epsilon_timesteps = 10_000
         self.sgd_rounds_per_step = 8
+        # Distributional C51 (Rainbow): >1 enables a categorical value
+        # distribution over `num_atoms` supports in [v_min, v_max].
+        self.num_atoms = 1
+        self.v_min = -10.0
+        self.v_max = 10.0
 
 
 class DQN(Algorithm):
@@ -45,9 +53,12 @@ class DQN(Algorithm):
         assert env.action_space.discrete, "DQN needs a discrete action space"
         obs_dim = int(np.prod(env.observation_space.shape))
         self.n_actions = env.action_space.n
-        sizes = (obs_dim, *cfg.model_hiddens, self.n_actions)
+        self.atoms = max(1, cfg.num_atoms)
+        sizes = (obs_dim, *cfg.model_hiddens, self.n_actions * self.atoms)
         self.params = _init_mlp(jax.random.key(cfg.env_seed), sizes,
                                 scale_last=0.01)
+        if self.atoms > 1:
+            self._z = jnp.linspace(cfg.v_min, cfg.v_max, self.atoms)
         self.target_params = jax.tree.map(jnp.copy, self.params)
         self.optimizer = optax.adam(cfg.lr)
         self.opt_state = self.optimizer.init(self.params)
@@ -57,7 +68,43 @@ class DQN(Algorithm):
         self._since_target_sync = 0
         self._rng = np.random.default_rng(cfg.env_seed)
         self._update = jax.jit(self._update_impl, donate_argnums=(0, 1))
-        self._qvals = jax.jit(lambda p, o: _mlp(p, o))
+        if self.atoms > 1:
+            self._qvals = jax.jit(
+                lambda p, o: self._expected_q(self._log_dist(p, o)))
+        else:
+            self._qvals = jax.jit(lambda p, o: _mlp(p, o))
+
+    # ---- C51 helpers (traced) ----
+
+    def _log_dist(self, params, obs):
+        """[B, A, atoms] log-probabilities of the value distribution."""
+        out = _mlp(params, obs)
+        return jax.nn.log_softmax(
+            out.reshape(-1, self.n_actions, self.atoms), axis=-1)
+
+    def _expected_q(self, log_p):
+        return jnp.sum(jnp.exp(log_p) * self._z, axis=-1)  # [B, A]
+
+    def _c51_project(self, p_next, rewards, dones):
+        """Categorical projection of r + gamma*z onto the fixed support
+        (C51, ref: dqn_torch_policy.py). One-hot matmuls, no scatter."""
+        cfg: DQNConfig = self.config
+        n = self.atoms
+        dz = (cfg.v_max - cfg.v_min) / (n - 1)
+        tz = jnp.clip(
+            rewards[:, None] + cfg.gamma * self._z[None, :]
+            * (1.0 - dones.astype(jnp.float32))[:, None],
+            cfg.v_min, cfg.v_max)
+        b = (tz - cfg.v_min) / dz                        # [B, n]
+        lf = jnp.floor(b)
+        wu = b - lf
+        wl = 1.0 - wu
+        l_idx = jnp.clip(lf, 0, n - 1).astype(jnp.int32)
+        u_idx = jnp.clip(lf + 1, 0, n - 1).astype(jnp.int32)
+        oh_l = jax.nn.one_hot(l_idx, n)                  # [B, n, n]
+        oh_u = jax.nn.one_hot(u_idx, n)
+        return (jnp.einsum("bk,bkj->bj", p_next * wl, oh_l)
+                + jnp.einsum("bk,bkj->bj", p_next * wu, oh_u))
 
     def _epsilon(self) -> float:
         cfg: DQNConfig = self.config
@@ -67,6 +114,25 @@ class DQN(Algorithm):
 
     def _update_impl(self, params, opt_state, target_params, batch, weights):
         cfg: DQNConfig = self.config
+
+        def c51_loss_fn(params):
+            log_p = self._log_dist(params, batch[sb.OBS])
+            a = batch[sb.ACTIONS].astype(jnp.int32)
+            log_p_taken = jnp.take_along_axis(
+                log_p, a[:, None, None].repeat(self.atoms, -1), axis=1)[:, 0]
+            log_p_next_t = self._log_dist(target_params, batch[sb.NEXT_OBS])
+            if cfg.double_q:
+                best = jnp.argmax(self._expected_q(
+                    self._log_dist(params, batch[sb.NEXT_OBS])), axis=1)
+            else:
+                best = jnp.argmax(self._expected_q(log_p_next_t), axis=1)
+            p_best = jnp.exp(jnp.take_along_axis(
+                log_p_next_t, best[:, None, None].repeat(self.atoms, -1),
+                axis=1)[:, 0])
+            m = jax.lax.stop_gradient(self._c51_project(
+                p_best, batch[sb.REWARDS], batch[sb.DONES]))
+            ce = -jnp.sum(m * log_p_taken, axis=-1)      # [B]
+            return jnp.mean(weights * ce), ce
 
         def loss_fn(params):
             q = _mlp(params, batch[sb.OBS])
@@ -85,7 +151,8 @@ class DQN(Algorithm):
             td = q_taken - jax.lax.stop_gradient(target)
             return jnp.mean(weights * td**2), td
 
-        (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        fn = c51_loss_fn if self.atoms > 1 else loss_fn
+        (loss, td), grads = jax.value_and_grad(fn, has_aux=True)(params)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, td

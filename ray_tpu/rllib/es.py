@@ -1,0 +1,228 @@
+"""ES: OpenAI-style evolution strategies, distributed over the actor plane.
+
+Parity: `/root/reference/rllib/algorithms/es/` (antithetic gaussian
+perturbations, centered-rank fitness shaping, seed-based noise
+reconstruction so workers never ship perturbation vectors, Adam on the
+estimated gradient). The reference shares a giant mmap'd noise table
+across workers (`es/utils.py` SharedNoiseTable); here each perturbation is
+regenerated from its integer seed on both ends — same zero-copy effect
+(only seeds and fitness scalars cross the wire, the object plane carries
+the current flat theta once per iteration) without the table.
+
+ES is the purest stress of the task/actor plane in RLlib: no gradients
+move, just (seed → episode return) fan-out/fan-in each iteration.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+def _centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Fitness shaping (ref: es/utils.py compute_centered_ranks): map
+    returns to ranks in [-0.5, 0.5] — scale-free, outlier-immune."""
+    flat = x.ravel()
+    ranks = np.empty(len(flat), dtype=np.float32)
+    ranks[flat.argsort()] = np.arange(len(flat), dtype=np.float32)
+    return (ranks.reshape(x.shape) / (len(flat) - 1)) - 0.5
+
+
+class _ESPolicy:
+    """Deterministic MLP policy on a flat parameter vector (host numpy —
+    per-step single-obs inference would be dominated by device dispatch)."""
+
+    def __init__(self, obs_dim: int, act_dim: int, hiddens, discrete: bool):
+        self.sizes = (obs_dim, *hiddens, act_dim)
+        self.discrete = discrete
+        self.shapes = []
+        for fan_in, fan_out in zip(self.sizes[:-1], self.sizes[1:]):
+            self.shapes.append(((fan_in, fan_out), (fan_out,)))
+        self.dim = sum(int(np.prod(w)) + int(np.prod(b))
+                       for w, b in self.shapes)
+
+    def init_flat(self, seed: int) -> np.ndarray:
+        rng = np.random.default_rng(seed)
+        chunks = []
+        for i, (wshape, bshape) in enumerate(self.shapes):
+            scale = (0.01 if i == len(self.shapes) - 1
+                     else np.sqrt(2.0 / wshape[0]))
+            chunks.append(rng.standard_normal(
+                int(np.prod(wshape))).astype(np.float32) * scale)
+            chunks.append(np.zeros(int(np.prod(bshape)), np.float32))
+        return np.concatenate(chunks)
+
+    def act(self, flat: np.ndarray, obs: np.ndarray) -> np.ndarray:
+        x = obs.astype(np.float32)
+        off = 0
+        for i, (wshape, bshape) in enumerate(self.shapes):
+            w = flat[off:off + int(np.prod(wshape))].reshape(wshape)
+            off += int(np.prod(wshape))
+            b = flat[off:off + int(np.prod(bshape))]
+            off += int(np.prod(bshape))
+            x = x @ w + b
+            if i < len(self.shapes) - 1:
+                x = np.tanh(x)
+        return x.argmax(axis=-1) if self.discrete else x
+
+
+class ESWorker:
+    """Evaluates antithetic perturbation pairs; runs as a ray_tpu actor."""
+
+    def __init__(self, env_name, hiddens, sigma, seed=0):
+        from ray_tpu.rllib.env import make_env
+
+        self.env = make_env(env_name, num_envs=1, seed=seed)
+        space = self.env.action_space
+        self.policy = _ESPolicy(
+            int(np.prod(self.env.observation_space.shape)),
+            space.n if space.discrete else int(np.prod(space.shape)),
+            tuple(hiddens), space.discrete)
+        self.sigma = sigma
+        self.act_low = None if space.discrete else space.low
+        self.act_high = None if space.discrete else space.high
+
+    def _episode(self, flat: np.ndarray) -> tuple[float, int]:
+        env = self.env
+        obs = env.reset()
+        total, steps = 0.0, 0
+        while True:
+            a = self.policy.act(flat, obs.reshape(1, -1))
+            if self.act_low is not None:
+                a = np.clip(a, self.act_low, self.act_high)
+            obs, r, done, trunc = env.step(a)
+            total += float(r[0])
+            steps += 1
+            if done[0] or trunc[0]:
+                return total, steps
+
+    def evaluate(self, theta: np.ndarray, seeds: list[int]) -> list:
+        """→ [(ret_plus, ret_minus, steps), ...] one row per seed."""
+        out = []
+        for s in seeds:
+            eps = np.random.default_rng(s).standard_normal(
+                self.policy.dim).astype(np.float32)
+            r_plus, n1 = self._episode(theta + self.sigma * eps)
+            r_minus, n2 = self._episode(theta - self.sigma * eps)
+            out.append((r_plus, r_minus, n1 + n2))
+        return out
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.pop_size = 32          # antithetic pairs per iteration
+        self.sigma = 0.05           # perturbation stddev
+        self.lr = 0.02
+        self.weight_decay = 0.005
+        self.num_rollout_workers = 0
+
+
+class ES(Algorithm):
+    def __init__(self, config: ESConfig):
+        # ES does its own fitness fan-out with ESWorker actors; keep the
+        # base WorkerSet local-only so we don't also spawn N unused
+        # gradient-style rollout actors.
+        self._n_eval_workers = config.num_rollout_workers
+        config = config.copy()
+        config.num_rollout_workers = 0
+        super().__init__(config)
+
+    @classmethod
+    def get_default_config(cls) -> ESConfig:
+        return ESConfig()
+
+    def setup(self) -> None:
+        cfg: ESConfig = self.config
+        env = self.workers.local.env
+        space = env.action_space
+        self._pol = _ESPolicy(
+            int(np.prod(env.observation_space.shape)),
+            space.n if space.discrete else int(np.prod(space.shape)),
+            tuple(cfg.model_hiddens), space.discrete)
+        self.theta = self._pol.init_flat(cfg.env_seed)
+        # Adam moments on the flat vector (ref: es/optimizers.py Adam).
+        self._m = np.zeros_like(self.theta)
+        self._v = np.zeros_like(self.theta)
+        self._t = 0
+        self._seed_counter = cfg.env_seed * 1_000_003 + 1
+        self._es_workers = []
+        if self._n_eval_workers > 0:
+            worker_cls = ray_tpu.remote(ESWorker)
+            self._es_workers = [
+                worker_cls.remote(cfg.env, tuple(cfg.model_hiddens),
+                                  cfg.sigma, seed=cfg.env_seed + 100 + i)
+                for i in range(self._n_eval_workers)]
+        else:
+            self._local_worker = ESWorker(
+                cfg.env, tuple(cfg.model_hiddens), cfg.sigma,
+                seed=cfg.env_seed + 100)
+
+    def training_step(self) -> dict:
+        cfg: ESConfig = self.config
+        seeds = [self._seed_counter + i for i in range(cfg.pop_size)]
+        self._seed_counter += cfg.pop_size
+        if self._es_workers:
+            theta_ref = ray_tpu.put(self.theta)
+            shards = np.array_split(np.asarray(seeds), len(self._es_workers))
+            refs = [w.evaluate.remote(theta_ref, [int(s) for s in shard])
+                    for w, shard in zip(self._es_workers, shards)
+                    if len(shard)]
+            rows = [r for out in ray_tpu.get(refs) for r in out]
+        else:
+            rows = self._local_worker.evaluate(self.theta, seeds)
+        returns = np.array([[r[0], r[1]] for r in rows], np.float32)
+        steps = int(sum(r[2] for r in rows))
+        self._timesteps_total += steps
+        ranks = _centered_ranks(returns)
+        pair_w = ranks[:, 0] - ranks[:, 1]          # [pop]
+        grad = np.zeros_like(self.theta)
+        for w, s in zip(pair_w, seeds):
+            if w != 0.0:
+                eps = np.random.default_rng(s).standard_normal(
+                    self._pol.dim).astype(np.float32)
+                grad += w * eps
+        grad /= (len(seeds) * cfg.sigma)
+        grad -= cfg.weight_decay * self.theta     # L2 toward 0
+        # Adam ascent.
+        self._t += 1
+        self._m = 0.9 * self._m + 0.1 * grad
+        self._v = 0.999 * self._v + 0.001 * grad * grad
+        m_hat = self._m / (1 - 0.9 ** self._t)
+        v_hat = self._v / (1 - 0.999 ** self._t)
+        self.theta += cfg.lr * m_hat / (np.sqrt(v_hat) + 1e-8)
+        return {
+            "episode_return_mean": float(returns.mean()),
+            "episode_return_max": float(returns.max()),
+            "episodes_this_iter": int(returns.size),
+        }
+
+    def get_weights(self):
+        return {"theta": np.array(self.theta), "m": np.array(self._m),
+                "v": np.array(self._v), "t": self._t,
+                "seed_counter": self._seed_counter}
+
+    def set_weights(self, weights) -> None:
+        self.theta = np.array(weights["theta"])
+        self._m = np.array(weights["m"])
+        self._v = np.array(weights["v"])
+        self._t = int(weights["t"])
+        # Restore the perturbation-seed cursor too, or a resumed run
+        # would replay the exact noise directions already consumed.
+        if "seed_counter" in weights:
+            self._seed_counter = int(weights["seed_counter"])
+
+    def stop(self) -> None:
+        for w in self._es_workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        super().stop()
+
+
+ESConfig.algo_class = ES
+
+__all__ = ["ES", "ESConfig", "ESWorker"]

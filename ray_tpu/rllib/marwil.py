@@ -1,0 +1,209 @@
+"""MARWIL + BC: offline imitation learning from logged experience.
+
+Parity: `/root/reference/rllib/algorithms/marwil/marwil.py` (monotonic
+advantage re-weighted imitation learning; exponentially advantage-weighted
+behavior cloning with a moving-average advantage normalizer) and
+`rllib/algorithms/bc/` (BC = MARWIL with beta = 0, pure log-likelihood).
+
+TPU-first differences from the reference's torch/tf pair: one functional
+JAX loss covering both discrete and continuous heads, the whole update
+jitted with donated params, and truncation-aware returns — a segment that
+ended on a time limit (or at the end of the logged stream) bootstraps its
+Monte-Carlo return through gamma^k * V(s_end) *inside the loss*, so the
+bootstrap tracks the improving value net instead of being frozen at
+postprocessing time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.env import Space
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.policy import Policy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+# Extra offline columns produced by postprocessing (see module docstring).
+MC_PARTIAL = "mc_partial"          # discounted reward sum to segment end
+GAMMA_TO_END = "gamma_to_end"      # gamma^(steps to segment end + 1)
+BOOT_OBS = "boot_obs"              # segment-final stored next_obs
+BOOT_MASK = "boot_mask"            # 1.0 if segment ended truncated / at tail
+
+
+def postprocess_returns(path: str, gamma: float) -> SampleBatch:
+    """Load a logged dataset (JsonWriter layout: each row is one vector env
+    step of shape [num_envs, ...], rows in write order) and attach the
+    columns needed for bootstrapped Monte-Carlo returns.
+
+    Per env stream, walking backwards: segments break where done | trunc;
+    a done boundary contributes no bootstrap, a truncated boundary (or the
+    unfinished stream tail) bootstraps through the stored pre-reset
+    next_obs. Rows missing a truncs column treat the tail as the only
+    truncation (old logs)."""
+    rows = list(JsonReader(path).read_rows())
+    if not rows:
+        raise FileNotFoundError(f"no offline rows under {path!r}")
+    num_envs = len(rows[0][sb.REWARDS])
+    T = len(rows)
+
+    def col(name, default=None):
+        if name not in rows[0]:
+            return default
+        return np.stack([r[name] for r in rows])   # [T, num_envs, ...]
+
+    obs = col(sb.OBS)
+    actions = col(sb.ACTIONS)
+    rewards = col(sb.REWARDS).astype(np.float32)
+    dones = col(sb.DONES).astype(bool)
+    truncs_col = col(sb.TRUNCS)
+    truncs = (np.zeros_like(dones) if truncs_col is None
+              else truncs_col.astype(bool))
+    next_obs = col(sb.NEXT_OBS)
+
+    mc = np.zeros((T, num_envs), np.float32)
+    g2e = np.zeros((T, num_envs), np.float32)
+    boot_obs = np.zeros_like(next_obs)
+    boot_mask = np.zeros((T, num_envs), np.float32)
+
+    finished = np.logical_or(dones, truncs)
+    # Walk each stream backwards carrying the running segment state.
+    run_mc = rewards[T - 1].copy()
+    run_g = np.full(num_envs, gamma, np.float32)
+    run_boot = next_obs[T - 1].copy()
+    # The stream tail is an implicit truncation unless the last row done.
+    run_mask = np.where(dones[T - 1], 0.0, 1.0).astype(np.float32)
+    mc[T - 1], g2e[T - 1] = run_mc, run_g
+    boot_obs[T - 1], boot_mask[T - 1] = run_boot, run_mask
+    for t in range(T - 2, -1, -1):
+        fin = finished[t]
+        ex = fin.reshape((-1,) + (1,) * (next_obs.ndim - 2))
+        run_mc = np.where(fin, rewards[t], rewards[t] + gamma * run_mc)
+        run_g = np.where(fin, gamma, gamma * run_g).astype(np.float32)
+        run_boot = np.where(ex, next_obs[t], run_boot)
+        run_mask = np.where(fin, truncs[t].astype(np.float32), run_mask)
+        mc[t], g2e[t] = run_mc, run_g
+        boot_obs[t], boot_mask[t] = run_boot, run_mask
+
+    flat = lambda a: a.reshape((-1,) + a.shape[2:])
+    return SampleBatch({
+        sb.OBS: flat(obs).astype(np.float32),
+        sb.ACTIONS: flat(actions),
+        MC_PARTIAL: mc.reshape(-1),
+        GAMMA_TO_END: g2e.reshape(-1),
+        BOOT_OBS: flat(boot_obs).astype(np.float32),
+        BOOT_MASK: boot_mask.reshape(-1),
+    })
+
+
+class MARWIL:
+    """Advantage-weighted behavior cloning from a logged dataset.
+
+    loss = -E[exp(beta * A / c) * logp(a|s)] + vf_coeff * E[(V - R)^2]
+    where A = R - V(s) (stop-gradient in the weight), and c is the moving
+    average of sqrt(E[A^2]) (the reference's moving_average_sqd_adv_norm,
+    marwil.py) so the exponent is scale-free across reward magnitudes.
+    """
+
+    def __init__(self, path: str, *, obs_dim: int, n_actions: int | None,
+                 act_shape: tuple = (), hiddens=(64, 64), lr: float = 1e-3,
+                 gamma: float = 0.99, beta: float = 1.0,
+                 vf_coeff: float = 1.0, max_weight: float = 20.0,
+                 ma_decay: float = 0.99, seed: int = 0):
+        self.gamma = gamma
+        self.data = postprocess_returns(path, gamma)
+        obs_space = Space((obs_dim,), np.float32)
+        if n_actions is not None:
+            action_space = Space((), np.int64, n=n_actions)
+        else:
+            action_space = Space(act_shape, np.float32,
+                                 low=-np.inf, high=np.inf)
+        self.policy = Policy(obs_space, action_space, hiddens=hiddens,
+                             seed=seed)
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.policy.params)
+        # Moving average of E[A^2]: jnp scalar threaded through the jitted
+        # update (donated) so the whole state lives on device.
+        self.ma_sq_adv = jnp.asarray(1.0, jnp.float32)
+        self._rng = np.random.default_rng(seed)
+        pol = self.policy
+
+        def update(params, opt_state, ma_sq, batch):
+            def loss_fn(params):
+                v = pol.value(params, batch[sb.OBS])
+                v_boot = pol.value(params, batch[BOOT_OBS])
+                ret = batch[MC_PARTIAL] + batch[GAMMA_TO_END] * (
+                    batch[BOOT_MASK] * jax.lax.stop_gradient(v_boot))
+                adv = jax.lax.stop_gradient(ret - v)
+                new_ma = ma_decay * ma_sq + (1 - ma_decay) * jnp.mean(
+                    adv ** 2)
+                if beta > 0:
+                    w = jnp.exp(jnp.clip(
+                        beta * adv / jnp.sqrt(new_ma + 1e-8),
+                        max=jnp.log(max_weight)))
+                else:
+                    w = jnp.ones_like(adv)
+                logp = pol._logp(params, batch[sb.OBS], batch[sb.ACTIONS])
+                pol_loss = -jnp.mean(w * logp)
+                vf_loss = jnp.mean((v - ret) ** 2)
+                return pol_loss + vf_coeff * vf_loss, (new_ma, pol_loss,
+                                                       vf_loss)
+            (loss, (new_ma, pl, vl)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_ma, loss, pl, vl
+
+        self._update = jax.jit(update, donate_argnums=(0, 1, 2))
+
+    def train_steps(self, n: int, batch_size: int = 256) -> dict:
+        loss = pl = vl = None
+        for _ in range(n):
+            idx = self._rng.integers(0, self.data.count, batch_size)
+            batch = {k: jnp.asarray(np.asarray(v)[idx])
+                     for k, v in self.data.items()}
+            (self.policy.params, self.opt_state, self.ma_sq_adv, loss,
+             pl, vl) = self._update(self.policy.params, self.opt_state,
+                                    self.ma_sq_adv, batch)
+        return {"loss": float(loss), "policy_loss": float(pl),
+                "vf_loss": float(vl),
+                "ma_sq_adv": float(self.ma_sq_adv)}
+
+    def evaluate(self, env_name: str, *, episodes: int = 20,
+                 seed: int = 1) -> float:
+        """Greedy (mode-action) rollout return of the cloned policy."""
+        from ray_tpu.rllib.env import make_env
+
+        env = make_env(env_name, num_envs=4, seed=seed)
+        pol = self.policy
+        mode = jax.jit(lambda p, o: pol._dist(p, o)[0])
+        obs = env.reset()
+        returns: list[float] = []
+        running = np.zeros(env.num_envs, np.float64)
+        while len(returns) < episodes:
+            out = np.asarray(mode(pol.params,
+                                  jnp.asarray(obs.astype(np.float32))))
+            actions = out.argmax(axis=1) if pol.discrete else out
+            obs, reward, done, trunc = env.step(actions)
+            running += reward
+            for i in np.nonzero(np.logical_or(done, trunc))[0]:
+                returns.append(float(running[i]))
+                running[i] = 0.0
+        return float(np.mean(returns))
+
+
+class BC(MARWIL):
+    """Behavior cloning: MARWIL with beta = 0 (uniform weights, pure
+    log-likelihood) — ref: rllib/algorithms/bc/bc.py subclassing MARWIL
+    the same way."""
+
+    def __init__(self, path: str, **kw):
+        kw["beta"] = 0.0
+        super().__init__(path, **kw)
+
+
+__all__ = ["BC", "MARWIL", "postprocess_returns"]

@@ -91,15 +91,18 @@ class JsonReader:
                         yield SampleBatch(
                             {k: _dec(v) for k, v in row.items()})
 
-    def read_all(self) -> SampleBatch:
-        out = []
+    def read_rows(self) -> "Iterator[SampleBatch]":
+        """All rows in WRITE order (shards sorted, no shuffle), one
+        SampleBatch per logged vector step — the layout consumers that
+        reconstruct per-env trajectories (MARWIL returns) rely on."""
         for fp in self.files:
             with open(fp) as f:
                 for line in f:
                     row = json.loads(line)
-                    out.append(SampleBatch(
-                        {k: _dec(v) for k, v in row.items()}))
-        return SampleBatch.concat(out)
+                    yield SampleBatch({k: _dec(v) for k, v in row.items()})
+
+    def read_all(self) -> SampleBatch:
+        return SampleBatch.concat(list(self.read_rows()))
 
 
 def collect_dataset(env_name: str, path: str, *, timesteps: int = 20_000,
@@ -139,6 +142,7 @@ def collect_dataset(env_name: str, path: str, *, timesteps: int = 20_000,
             sb.ACTIONS: actions.astype(np.int64),
             sb.REWARDS: reward.astype(np.float32),
             sb.DONES: done,
+            sb.TRUNCS: trunc,
             sb.NEXT_OBS: stored_next.astype(np.float32),
         }))
         obs = next_obs

@@ -53,7 +53,8 @@ class LLMEngine:
 
     def __init__(self, cfg, params=None, *, n_slots: int = 8,
                  max_len: int = 2048, seed: int = 0,
-                 prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512)):
+                 prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
+                 decode_block: int = 8):
         import jax
 
         from ray_tpu.models import gpt
@@ -74,10 +75,19 @@ class LLMEngine:
         self.cache = init_kv_cache(cfg, n_slots, max_len)
         self.tokens = np.zeros(n_slots, np.int32)
         self.positions = np.zeros(n_slots, np.int32)
+        self.temps = np.zeros(n_slots, np.float32)
+        # Fused decode-window sizes (largest first): one dispatch advances
+        # all slots k tokens with on-device sampling, amortizing the
+        # host↔device round trip that dominates per-token latency on
+        # remote-dispatch links. Power-of-two ladder bounds compile count.
+        self.decode_block = max(1, decode_block)
+        self._k_ladder = tuple(
+            k for k in (64, 32, 16, 8, 4, 2) if k <= self.decode_block)
         self.slot_req: list[GenRequest | None] = [None] * n_slots
         self.pending: "queue.Queue[GenRequest]" = queue.Queue()
         self._rng_key = jax.random.key(seed)
         self._shutdown = threading.Event()
+        self._fatal: str | None = None
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
         self.stats = {"requests": 0, "tokens_generated": 0,
@@ -102,8 +112,14 @@ class LLMEngine:
             submitted_at=time.perf_counter(),
             stream=queue.Queue() if stream else None,
         )
-        self.stats["requests"] += 1
-        self.pending.put(req)
+        # The fatal check and the enqueue must be atomic with the death
+        # handler's one-shot pending drain, or a submit racing the dying
+        # engine could enqueue after the drain and hang forever.
+        with self._lock:
+            if self._fatal is not None:
+                raise RuntimeError(self._fatal)
+            self.stats["requests"] += 1
+            self.pending.put(req)
         return req
 
     def generate(self, prompt_ids: list[int], **kw) -> list[int]:
@@ -203,52 +219,128 @@ class LLMEngine:
                 self.slot_req[slot] = req
             self.tokens[slot] = tok
             self.positions[slot] = n
+            self.temps[slot] = req.temperature
             if self._emit(req, tok):
-                with self._lock:
-                    self.slot_req[slot] = None
+                self._release(slot)
+
+    def _release(self, slot: int) -> None:
+        """Free a slot. Positions reset so multi-step windows never walk an
+        idle slot's write cursor toward the cache boundary."""
+        with self._lock:
+            self.slot_req[slot] = None
+        self.tokens[slot] = 0
+        self.positions[slot] = 0
+        self.temps[slot] = 0.0
+
+    def _finish_capacity(self, slot: int) -> None:
+        """Slot exhausted the cache: finish early rather than overflow."""
+        req = self.slot_req[slot]
+        req.error = None
+        req.finished_at = time.perf_counter()
+        self.stats["completed"] += 1
+        if req.stream is not None:
+            req.stream.put(None)
+        req.done.set()
+        self._release(slot)
+
+    def _pick_window(self, active: list[int]) -> int:
+        """Fused-decode window size. Bounded by the LONGEST remaining
+        budget (a nearly-done slot trims its tail host-side rather than
+        forcing k=1 on everyone — its wasted window tokens cost ~ms of
+        compute vs a full RTT per token saved) and, strictly, by the
+        KV-cache capacity of the furthest-along slot (scatter writes past
+        max_len would be dropped and the slot's attention mask poisoned)."""
+        remaining = max(self.slot_req[s].max_tokens
+                        - len(self.slot_req[s].out_ids) for s in active)
+        # Mid-window eos trimming wastes the tail of the window; requests
+        # with an eos_id cap the window to keep waste bounded.
+        if any(self.slot_req[s].eos_id is not None for s in active):
+            remaining = min(remaining, 8)
+        cap = self.max_len - int(max(self.positions[s] for s in active))
+        bound = min(remaining, cap)
+        for k in self._k_ladder:
+            if k <= bound:
+                return k
+        return 1
 
     def step(self) -> int:
-        """Admit + one decode step for all active slots. → #active."""
+        """Admit + one fused decode window for all active slots. → #active."""
+        import jax
         import jax.numpy as jnp
 
-        from ray_tpu.models.decode import decode_step
+        from ray_tpu.models.decode import decode_multi, decode_step
 
         self._admit()
         active = [i for i in range(self.n_slots)
                   if self.slot_req[i] is not None]
         if not active:
             return 0
+        k = self._pick_window(active)
+        if k > 1:
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            toks_out, self.cache = decode_multi(
+                self.cfg, self.params, jnp.asarray(self.tokens), self.cache,
+                jnp.asarray(self.positions), k, jnp.asarray(self.temps), sub)
+            toks_out = np.asarray(toks_out)  # [k, B]
+            for slot in active:
+                req = self.slot_req[slot]
+                finished = False
+                for i in range(k):
+                    if self._emit(req, int(toks_out[i, slot])):
+                        finished = True
+                        break
+                if finished:
+                    self._release(slot)
+                else:
+                    self.tokens[slot] = toks_out[k - 1, slot]
+                    self.positions[slot] += k
+            return len(active)
         logits, self.cache = decode_step(
             self.cfg, self.params, jnp.asarray(self.tokens), self.cache,
             jnp.asarray(self.positions))
         logits = np.asarray(logits)
         for slot in active:
             req = self.slot_req[slot]
-            # Slot exhausted the cache: finish early rather than overflow.
             if self.positions[slot] + 1 >= self.max_len:
-                req.error = None
-                req.finished_at = time.perf_counter()
-                self.stats["completed"] += 1
-                if req.stream is not None:
-                    req.stream.put(None)
-                req.done.set()
-                with self._lock:
-                    self.slot_req[slot] = None
+                self._finish_capacity(slot)
                 continue
             tok = self._sample(logits[slot], req.temperature)
             self.tokens[slot] = tok
             self.positions[slot] += 1
             if self._emit(req, tok):
-                with self._lock:
-                    self.slot_req[slot] = None
+                self._release(slot)
         return len(active)
 
     def _loop(self) -> None:
-        while not self._shutdown.is_set():
-            n = self.step()
-            if n == 0 and self.pending.empty():
-                # Idle: block briefly instead of spinning.
-                time.sleep(0.002)
+        try:
+            while not self._shutdown.is_set():
+                n = self.step()
+                if n == 0 and self.pending.empty():
+                    # Idle: block briefly instead of spinning.
+                    time.sleep(0.002)
+        except Exception as exc:  # noqa: BLE001
+            # The engine thread is the only consumer: if it dies (e.g. an
+            # XLA OOM at compile time), every queued/active request would
+            # otherwise hang until client timeout. Fail them all loudly
+            # and poison future submits instead. Setting _fatal and
+            # draining happen under the submit lock (see submit()).
+            with self._lock:
+                self._fatal = f"engine died: {exc!r}"
+                doomed = []
+                for slot, req in enumerate(self.slot_req):
+                    if req is not None:
+                        doomed.append(req)
+                        self.slot_req[slot] = None
+                while True:
+                    try:
+                        doomed.append(self.pending.get_nowait())
+                    except queue.Empty:
+                        break
+            for req in doomed:
+                req.error = self._fatal
+                if req.stream is not None:
+                    req.stream.put(None)
+                req.done.set()
 
 
 class LLMDeployment:

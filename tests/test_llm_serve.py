@@ -174,6 +174,39 @@ class TestContinuousBatching:
         finally:
             eng.stop()
 
+    def test_engine_death_fails_requests_loudly(self, params):
+        """If the engine thread dies (e.g. XLA OOM at compile), queued and
+        active requests error out immediately instead of hanging until
+        client timeout, and later submits are poisoned."""
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=64,
+                        prefill_buckets=(8,))
+        eng.step = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        req = eng.submit([5, 9], max_tokens=4, stream=True)  # pre-queued
+        eng.start()
+        assert req.done.wait(10)
+        assert req.error and "boom" in req.error
+        assert req.stream.get(timeout=5) is None  # stream closed
+        with pytest.raises(RuntimeError, match="engine died"):
+            eng.submit([1], max_tokens=1)
+        eng.stop()
+
+    def test_multi_step_matches_single_step(self, params):
+        """Fused decode windows (decode_multi) reproduce the exact greedy
+        token sequence of per-token decode_step dispatch."""
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=64,
+                        prefill_buckets=(8,), decode_block=8)
+        ref = LLMEngine(CFG, params, n_slots=2, max_len=64,
+                        prefill_buckets=(8,), decode_block=1)
+        eng.start()
+        ref.start()
+        try:
+            a = eng.generate([5, 9, 2], max_tokens=16)
+            b = ref.generate([5, 9, 2], max_tokens=16)
+            assert a == b and len(a) == 16
+        finally:
+            eng.stop()
+            ref.stop()
+
     def test_max_len_finishes_cleanly(self, params):
         eng = LLMEngine(CFG, params, n_slots=1, max_len=12,
                         prefill_buckets=(8,))
