@@ -200,6 +200,22 @@ class Config:
     # Open-connection cap per ingress proxy (memory bound under overload:
     # at most max_connections × max_body_bytes buffered).
     serve_http_max_connections: int = 2048
+    # Idle keep-alive read deadline at the ingress (header/body waits).
+    serve_http_idle_timeout_s: float = 300.0
+    # Handle routing-table staleness safety net (push is primary; this
+    # bounds how long a lost notify can serve a stale replica list).
+    serve_handle_refresh_ttl_s: float = 10.0
+    # How long a handle waits for the first replica of a scale-from-zero
+    # cold start before failing the request.
+    serve_cold_start_timeout_s: float = 60.0
+
+    # --- LLM serving engine ---
+    # Fused decode window: tokens generated per device dispatch with
+    # on-device sampling. The dominant knob when dispatch latency is
+    # non-trivial (remote tunnel, loaded host); 1 = per-token dispatch.
+    llm_decode_block: int = 8
+    # Finished-but-unread token streams are garbage-collected after this.
+    llm_stream_ttl_s: float = 600.0
 
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"

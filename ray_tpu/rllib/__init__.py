@@ -17,7 +17,9 @@ from ray_tpu.rllib.env import (
     register_env,
 )
 from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.ars import ARS, ARSConfig
 from ray_tpu.rllib.es import ES, ESConfig
+from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.connectors import (
     ClipActions,
     Connector,
@@ -51,7 +53,7 @@ __all__ = [
     "DQN", "DQNConfig", "SAC", "SACConfig", "IMPALA", "IMPALAConfig",
     "APPO", "APPOConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
     "Connector", "ConnectorPipeline", "MeanStdFilter", "ClipActions",
-    "BC", "MARWIL", "ES", "ESConfig",
+    "BC", "MARWIL", "ES", "ESConfig", "ARS", "ARSConfig", "PG", "PGConfig",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",

@@ -1,0 +1,63 @@
+"""ARS: augmented random search.
+
+Parity: `/root/reference/rllib/algorithms/ars/` (Mania et al. 2018,
+"basic random search" V1): antithetic perturbations like ES, but the
+update keeps only the `num_top` best directions (ranked by
+max(r+, r-)), weights them by raw reward differences, and normalizes by
+the std-dev of the used returns — no rank shaping, no Adam, a plain SGD
+step. Shares the seed-reconstructed noise and the actor-plane fitness
+fan-out with ES (rllib/es.py); only the aggregation differs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.es import ES, ESConfig
+
+
+class ARSConfig(ESConfig):
+    def __init__(self):
+        super().__init__()
+        self.pop_size = 32
+        self.sigma = 0.05
+        self.lr = 0.02
+        # Directions kept per update (<= pop_size); the elite filter is
+        # ARS's variance-reduction move in place of ES's centered ranks.
+        self.num_top = 16
+
+
+class ARS(ES):
+    @classmethod
+    def get_default_config(cls) -> ARSConfig:
+        return ARSConfig()
+
+    def training_step(self) -> dict:
+        cfg: ARSConfig = self.config
+        rows, seeds = self._evaluate_population(cfg.pop_size)
+        returns = np.array([[r[0], r[1]] for r in rows], np.float32)
+        steps = int(sum(r[2] for r in rows))
+        self._timesteps_total += steps
+        # Elite filter: rank directions by the better of the two signs.
+        order = np.argsort(-returns.max(axis=1))[: max(1, cfg.num_top)]
+        used = returns[order]
+        sigma_r = float(used.std()) + 1e-8
+        grad = np.zeros_like(self.theta)
+        for i in order:
+            w = float(returns[i, 0] - returns[i, 1])
+            if w != 0.0:
+                eps = np.random.default_rng(seeds[i]).standard_normal(
+                    self._pol.dim).astype(np.float32)
+                grad += w * eps
+        self.theta += cfg.lr / (len(order) * sigma_r) * grad
+        return {
+            "episode_return_mean": float(returns.mean()),
+            "episode_return_max": float(returns.max()),
+            "elite_return_mean": float(used.mean()),
+            "episodes_this_iter": int(returns.size),
+        }
+
+
+ARSConfig.algo_class = ARS
+
+__all__ = ["ARS", "ARSConfig"]

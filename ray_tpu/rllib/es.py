@@ -160,10 +160,11 @@ class ES(Algorithm):
                 cfg.env, tuple(cfg.model_hiddens), cfg.sigma,
                 seed=cfg.env_seed + 100)
 
-    def training_step(self) -> dict:
-        cfg: ESConfig = self.config
-        seeds = [self._seed_counter + i for i in range(cfg.pop_size)]
-        self._seed_counter += cfg.pop_size
+    def _evaluate_population(self, pop_size: int):
+        """Fan one antithetic population out over the eval workers.
+        → (rows [(r+, r-, steps)...], seeds) in matching order."""
+        seeds = [self._seed_counter + i for i in range(pop_size)]
+        self._seed_counter += pop_size
         if self._es_workers:
             theta_ref = ray_tpu.put(self.theta)
             shards = np.array_split(np.asarray(seeds), len(self._es_workers))
@@ -173,6 +174,11 @@ class ES(Algorithm):
             rows = [r for out in ray_tpu.get(refs) for r in out]
         else:
             rows = self._local_worker.evaluate(self.theta, seeds)
+        return rows, seeds
+
+    def training_step(self) -> dict:
+        cfg: ESConfig = self.config
+        rows, seeds = self._evaluate_population(cfg.pop_size)
         returns = np.array([[r[0], r[1]] for r in rows], np.float32)
         steps = int(sum(r[2] for r in rows))
         self._timesteps_total += steps

@@ -1,0 +1,87 @@
+"""PG: vanilla policy gradient (REINFORCE).
+
+Parity: `/root/reference/rllib/algorithms/pg/` — the simplest on-policy
+baseline: loss = -E[logp(a|s) * R_t] on Monte-Carlo discounted returns,
+no learned critic, no clipping. The reference keeps it as the didactic
+floor of the algorithm family; same role here, sharing the rollout and
+batch machinery with A2C/PPO. One jitted update per collected batch with
+donated params.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 4e-3
+        self.entropy_coeff = 0.0
+        # Center returns per batch (variance reduction without a critic;
+        # the reference's PG leaves returns raw — this is strictly
+        # optional and off reproduces that).
+        self.center_returns = True
+
+
+class PG(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> PGConfig:
+        return PGConfig()
+
+    def setup(self) -> None:
+        cfg: PGConfig = self.config
+        self.policy = self.workers.local.policy
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+
+    def _update_impl(self, params, opt_state, batch):
+        cfg: PGConfig = self.config
+        pol = self.policy
+
+        def loss_fn(params):
+            logp = pol._logp(params, batch[sb.OBS], batch[sb.ACTIONS])
+            ret = batch[sb.VALUE_TARGETS]       # MC returns (lambda=1)
+            if cfg.center_returns:
+                ret = ret - jnp.mean(ret)
+            loss = -jnp.mean(logp * ret)
+            if cfg.entropy_coeff > 0:
+                loss = loss - cfg.entropy_coeff * jnp.mean(
+                    pol._entropy(params, batch[sb.OBS]))
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def training_step(self) -> dict:
+        cfg: PGConfig = self.config
+        # lam=1.0 makes VALUE_TARGETS the pure Monte-Carlo discounted
+        # return; the vf head exists but is unused (vf_preds enter GAE
+        # only through the lambda-weighting, which lam=1 cancels except
+        # at the bootstrap tail).
+        train_batch = sb.collect_on_policy_batch(
+            self.workers, gamma=cfg.gamma, lam=1.0)
+        self._timesteps_total += train_batch.count
+        dev = {k: jnp.asarray(v) for k, v in train_batch.items()}
+        self.policy.params, self.opt_state, loss = self._update(
+            self.policy.params, self.opt_state, dev)
+        return {"total_loss": float(loss)}
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+
+PGConfig.algo_class = PG
+
+__all__ = ["PG", "PGConfig"]

@@ -144,9 +144,12 @@ class DeploymentHandle:
     so scaling/deletion is visible at the next call — the TTL is only a
     safety net against a lost notify."""
 
-    REFRESH_TTL_S = 10.0
-
     def __init__(self, deployment_name: str):
+        from ray_tpu.core.config import runtime_config
+
+        _cfg = runtime_config()
+        self.REFRESH_TTL_S = _cfg.serve_handle_refresh_ttl_s
+        self.COLD_START_TIMEOUT_S = _cfg.serve_cold_start_timeout_s
         self.deployment_name = deployment_name
         self._version = -1
         self._replicas: list = []
@@ -186,8 +189,6 @@ class DeploymentHandle:
             r for r in replicas
             if not client.actor_state(r._actor_id.binary()).dead
         ]
-
-    COLD_START_TIMEOUT_S = 60.0
 
     def _pick_replica(self):
         replicas: list = []

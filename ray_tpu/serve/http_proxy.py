@@ -131,6 +131,7 @@ class HTTPProxy(_RouterMixin):
         self._timeout = (request_timeout_s if request_timeout_s is not None
                          else cfg.serve_http_request_timeout_s)
         self._max_body = cfg.serve_http_max_body_bytes
+        self._idle_timeout = cfg.serve_http_idle_timeout_s
         self._max_conns = cfg.serve_http_max_connections
         self._conns = 0
         self._inflight = 0
@@ -191,7 +192,8 @@ class HTTPProxy(_RouterMixin):
             while True:
                 try:
                     head = await asyncio.wait_for(
-                        reader.readuntil(b"\r\n\r\n"), timeout=300)
+                        reader.readuntil(b"\r\n\r\n"),
+                        timeout=self._idle_timeout)
                 except (asyncio.IncompleteReadError, asyncio.TimeoutError,
                         ConnectionResetError, asyncio.LimitOverrunError):
                     return
@@ -232,7 +234,8 @@ class HTTPProxy(_RouterMixin):
                     return
                 try:
                     body = (await asyncio.wait_for(
-                        reader.readexactly(length), timeout=300)
+                        reader.readexactly(length),
+                        timeout=self._idle_timeout)
                         if length else b"")
                 except (asyncio.IncompleteReadError, asyncio.TimeoutError):
                     return  # client stalled or vanished mid-body

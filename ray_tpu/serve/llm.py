@@ -54,7 +54,7 @@ class LLMEngine:
     def __init__(self, cfg, params=None, *, n_slots: int = 8,
                  max_len: int = 2048, seed: int = 0,
                  prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
-                 decode_block: int = 8):
+                 decode_block: int | None = None):
         import jax
 
         from ray_tpu.models import gpt
@@ -80,6 +80,10 @@ class LLMEngine:
         # all slots k tokens with on-device sampling, amortizing the
         # host↔device round trip that dominates per-token latency on
         # remote-dispatch links. Power-of-two ladder bounds compile count.
+        if decode_block is None:
+            from ray_tpu.core.config import runtime_config
+
+            decode_block = runtime_config().llm_decode_block
         self.decode_block = max(1, decode_block)
         self._k_ladder = tuple(
             k for k in (64, 32, 16, 8, 4, 2) if k <= self.decode_block)
@@ -398,11 +402,12 @@ class LLMDeployment:
     # moment prefill lands (ref: the reference proxy's ASGI streaming,
     # http_proxy.py:217 — VERDICT r2 missing #2).
 
-    _STREAM_TTL_S = 600.0
-
     def submit_stream(self, request: dict) -> str:
         if not hasattr(self, "_streams"):
+            from ray_tpu.core.config import runtime_config
+
             self._streams: dict[str, Any] = {}
+            self._STREAM_TTL_S = runtime_config().llm_stream_ttl_s
         self._gc_streams()
         req = self.engine.submit(
             request["prompt_ids"],

@@ -825,3 +825,42 @@ class TestES:
         w = algo.get_weights()
         algo.set_weights(w)
         algo.stop()
+
+
+class TestPG:
+    def test_pg_improves_cartpole(self):
+        """Vanilla REINFORCE (ref: rllib/algorithms/pg) clears random play
+        on CartPole within a small budget."""
+        from ray_tpu.rllib import PGConfig
+
+        cfg = (PGConfig()
+               .environment("CartPole-v1", seed=0)
+               .rollouts(num_rollout_workers=0, num_envs_per_worker=8,
+                         rollout_fragment_length=64)
+               .training(lr=4e-3, entropy_coeff=0.01))
+        algo = cfg.build()
+        for _ in range(30):
+            algo.train()
+        final = algo.workers.local.metrics()["episode_return_mean"]
+        assert final is not None and final > 45, final
+        algo.stop()
+
+
+class TestARS:
+    def test_ars_learns_cartpole(self):
+        """Top-k elite filtering (ref: rllib/algorithms/ars) learns
+        CartPole with a plain SGD step on raw reward differences."""
+        from ray_tpu.rllib import ARSConfig
+
+        cfg = (ARSConfig().environment("CartPole-v1", seed=3)
+               .training(pop_size=24, num_top=8, sigma=0.1, lr=0.05,
+                         model_hiddens=(32,)))
+        algo = cfg.build()
+        first = algo.train()["episode_return_mean"]
+        best = first
+        for _ in range(25):
+            r = algo.train()
+            best = max(best, r["episode_return_mean"])
+            assert "elite_return_mean" in r
+        algo.stop()
+        assert best > first + 40, (first, best)

@@ -116,6 +116,16 @@ def main() -> None:
             out.append(run_impala_pixel(budget))
         finally:
             ray_tpu.shutdown()
+    # Merge into the existing summary so a single-algo rerun doesn't
+    # erase the other algo's committed result.
+    prev = []
+    if os.path.exists(SUMMARY):
+        try:
+            prev = json.load(open(SUMMARY))
+        except Exception:
+            prev = []
+    done = {r["algo"] for r in out}
+    out = [r for r in prev if r["algo"] not in done] + out
     json.dump(out, open(SUMMARY, "w"), indent=1)
     print(json.dumps(out))
 
