@@ -61,15 +61,23 @@ def main() -> None:
             gpt.init_params(cfg, jax.random.key(0)))
     engine = LLMEngine(cfg, params, n_slots=args.n_slots, max_len=1024,
                        decode_block=args.decode_block)
-    engine.start()
     rng = np.random.default_rng(0)
 
-    # Warm the prefill bucket + every decode-window size the measured
-    # requests will hit (a full-length request walks the whole k ladder).
-    warm = engine.submit(
-        list(rng.integers(0, cfg.vocab_size, args.prompt_len)),
-        max_tokens=args.max_tokens)
-    warm.done.wait(600)
+    # Warm every admission-group size (8/4/2/1 batched prefill) and every
+    # decode-window size the measured requests will hit. The engine thread
+    # is not started yet, so step() is driven synchronously and the queued
+    # burst sizes deterministically become the admission group sizes.
+    def drive(reqs):
+        while not all(r.done.is_set() for r in reqs):
+            engine.step()
+
+    prompt = lambda: list(rng.integers(0, cfg.vocab_size, args.prompt_len))
+    for burst in (8, 4, 2):
+        if burst <= args.n_slots:
+            drive([engine.submit(prompt(), max_tokens=2)
+                   for _ in range(burst)])
+    drive([engine.submit(prompt(), max_tokens=args.max_tokens)])
+    engine.start()
 
     results = []
     lock = threading.Lock()

@@ -403,7 +403,7 @@ class CoreClient:
             n = min(chunk, size - off)
             data = await self.raylet.call("obj_read_chunk", {
                 "object_id": oid, "offset": off, "length": n,
-            }, timeout=300)
+            }, timeout=self.config.remote_chunk_rpc_timeout_s)
             if data is None:
                 return None
             buf[off:off + n] = data
@@ -439,7 +439,7 @@ class CoreClient:
                     await self.raylet.call("store_write_chunk", {
                         "object_id": oid, "offset": off,
                         "data": bytes(mv[off:off + chunk]),
-                    }, timeout=300)
+                    }, timeout=self.config.remote_chunk_rpc_timeout_s)
                 await self.raylet.call("store_seal_remote", {
                     "object_id": oid})
         else:
@@ -552,8 +552,9 @@ class CoreClient:
                 elif loc == "remote_chunked":
                     # ray:// driver streaming a large object: assemble from
                     # chunk reads (each its own RPC frame).
-                    buf = self._run(self._read_remote_chunks(key, data),
-                                    timeout=600)
+                    buf = self._run(
+                        self._read_remote_chunks(key, data),
+                        timeout=self.config.remote_object_op_timeout_s)
                     if buf is None:
                         still.append((i, key))
                         continue
@@ -776,7 +777,7 @@ class CoreClient:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(0.005)
+            time.sleep(self.config.wait_poll_interval_s)
         return ready, pending
 
     def free(self, refs: Sequence) -> None:

@@ -51,6 +51,16 @@ class Config:
     object_serve_fanout: int = 3
     # Reclaim a serve slot whose puller died after this long.
     object_serve_slot_ttl_s: float = 120.0
+    # Initial backoff between directory re-checks inside one pull attempt
+    # (doubles up to object_pull_retry_interval_s).
+    object_pull_backoff_s: float = 0.1
+    # Fraction of store capacity one admitted pull may occupy; larger
+    # pulls queue until space frees (create-queue backpressure,
+    # ref: plasma create_request_queue.cc).
+    pull_admission_fraction: float = 0.25
+    # Busy-poll cadence of a blocking ray_tpu.wait() between readiness
+    # re-checks.
+    wait_poll_interval_s: float = 0.005
 
     # --- scheduling ---
     # Hybrid policy: pack onto nodes below this utilization, then spread
@@ -124,6 +134,8 @@ class Config:
     # restart (ref: ray_config_def.h:70
     # gcs_failover_worker_reconnect_timeout).
     gcs_reconnect_window_s: float = 60.0
+    # Delay between reconnect attempts inside that window.
+    gcs_reconnect_backoff_s: float = 0.5
 
     # Remote driver ("ray://") mode: the client cannot mmap the node's
     # /dev/shm arena, so object data rides the RPC connection instead
@@ -135,6 +147,9 @@ class Config:
     # instead of one RPC frame (the reference's client proxies arbitrarily
     # large objects via plasma chunking, util/client/).
     remote_object_chunk_bytes: int = 64 * 1024**2
+    # Per-chunk RPC deadline and whole-object deadline for those streams.
+    remote_chunk_rpc_timeout_s: float = 300.0
+    remote_object_op_timeout_s: float = 600.0
 
     # Stream worker stdout/stderr (user prints) to connected drivers
     # (ref: _private/log_monitor.py:100 → driver prints).

@@ -1184,7 +1184,7 @@ class Raylet:
         import random
 
         deadline = (time.monotonic() + timeout) if timeout else None
-        backoff = 0.1
+        backoff = self.config.object_pull_backoff_s
         while True:
             locs = await self.gcs.call(
                 "obj_loc_get", {"object_id": obj.binary()})
@@ -1278,8 +1278,9 @@ class Raylet:
         await fut
 
     def _pump_pull_admission(self) -> None:
-        limit = max(int(self.store.capacity * 0.25),
-                    self.config.object_transfer_chunk_size)
+        limit = max(
+            int(self.store.capacity * self.config.pull_admission_fraction),
+            self.config.object_transfer_chunk_size)
         while self._pull_waiters:
             size, fut = self._pull_waiters[0]
             if fut.done():

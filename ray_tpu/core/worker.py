@@ -355,7 +355,7 @@ class Worker:
             deadline = time.time() + min(
                 self.config.worker_preflush_window_s,
                 self.config.gcs_reconnect_window_s)
-            delay = 0.5
+            delay = self.config.gcs_reconnect_backoff_s
             while True:
                 try:
                     # Per-attempt timeout bounded by the remaining deadline:

@@ -332,6 +332,51 @@ class Dataset:
 
         return self._with_stage(MapStage(f"add_column({name})", apply))
 
+    def select_columns(self, cols: list) -> "Dataset":
+        """Keep only `cols` (ref: dataset.py select_columns)."""
+        cols = list(cols)
+
+        def apply(blk):
+            batch = B.to_batch(blk, "numpy")
+            if not isinstance(batch, dict):
+                raise TypeError("select_columns() requires a tabular dataset")
+            missing = [c for c in cols if c not in batch]
+            if missing:
+                raise KeyError(f"unknown columns {missing}")
+            return B.from_batch({c: batch[c] for c in cols})
+
+        return self._with_stage(MapStage(f"select_columns({cols})", apply))
+
+    def drop_columns(self, cols: list) -> "Dataset":
+        """Remove `cols` (ref: dataset.py drop_columns)."""
+        drop = set(cols)
+
+        def apply(blk):
+            batch = B.to_batch(blk, "numpy")
+            if not isinstance(batch, dict):
+                raise TypeError("drop_columns() requires a tabular dataset")
+            return B.from_batch(
+                {k: v for k, v in batch.items() if k not in drop})
+
+        return self._with_stage(MapStage(f"drop_columns({cols})", apply))
+
+    def rename_columns(self, mapping: dict) -> "Dataset":
+        """Rename columns by {old: new} (ref: dataset.py rename_columns)."""
+        mapping = dict(mapping)
+
+        def apply(blk):
+            batch = B.to_batch(blk, "numpy")
+            if not isinstance(batch, dict):
+                raise TypeError("rename_columns() requires a tabular dataset")
+            out = {mapping.get(k, k): v for k, v in batch.items()}
+            if len(out) != len(batch):
+                raise ValueError(
+                    f"rename_columns mapping {mapping} collides with an "
+                    f"existing column (columns: {sorted(batch)})")
+            return B.from_batch(out)
+
+        return self._with_stage(MapStage("rename_columns", apply))
+
     def random_sample(self, fraction: float, *,
                       seed: int | None = None) -> "Dataset":
         """Keep each row independently with probability `fraction`
@@ -472,6 +517,47 @@ class Dataset:
             cur += 1
         return [Dataset(refs_i, []) for refs_i in out]
 
+    def split_at_indices(self, indices: list) -> list["Dataset"]:
+        """Split at global row indices (ref: dataset.py split_at_indices):
+        [3, 7] → rows [0,3), [3,7), [7, N)."""
+        idx = list(indices)
+        if any(b < a for a, b in zip(idx, idx[1:])) or (idx and idx[0] < 0):
+            raise ValueError(f"indices must be non-decreasing ≥ 0: {idx}")
+        refs = self._materialized_refs()
+        counts = ray_tpu.get(
+            [_count_task.remote(r) for r in refs], timeout=300)
+        total = sum(counts)
+        bounds = [0] + [min(i, total) for i in idx] + [total]
+        out: list[list] = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            part: list = []
+            pos = 0
+            for ref, cnt in zip(refs, counts):
+                s, e = max(lo - pos, 0), min(hi - pos, cnt)
+                if s < e:
+                    part.append(ref if (s, e) == (0, cnt)
+                                else _slice_task.remote(ref, s, e))
+                pos += cnt
+            out.append(part)
+        return [Dataset(p, []) for p in out]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: int | None = None) -> tuple:
+        """→ (train, test) datasets (ref: dataset.py train_test_split).
+        test_size is a fraction in (0, 1)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        # Materialize once: count() and split_at_indices() would otherwise
+        # each re-run the pending pipeline (incl. the shuffle all-to-all),
+        # and a seedless shuffle would split a DIFFERENT permutation than
+        # the one counted.
+        ds = ds.materialize()
+        total = ds.count()
+        cut = total - int(total * test_size)
+        train, test = ds.split_at_indices([cut])
+        return train, test
+
     def union(self, other: "Dataset") -> "Dataset":
         return Dataset(
             self._materialized_refs() + other._materialized_refs(), []
@@ -512,6 +598,20 @@ class Dataset:
 
     def max(self, on: str | None = None):
         return self._column_values(on).max()
+
+    def std(self, on: str | None = None, ddof: int = 1):
+        """Sample standard deviation (ref: dataset.py std)."""
+        v = self._column_values(on)
+        return float(np.std(v, ddof=ddof))
+
+    def unique(self, on: str | None = None) -> list:
+        """Distinct values of a column (ref: dataset.py unique)."""
+        return sorted(np.unique(self._column_values(on)).tolist())
+
+    def show(self, n: int = 20) -> None:
+        """Print the first n rows (ref: dataset.py show)."""
+        for row in self.take(n):
+            print(row)
 
     def _column_values(self, on: str | None) -> np.ndarray:
         parts = []

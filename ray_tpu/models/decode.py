@@ -109,6 +109,51 @@ def prefill(cfg: GPTConfig, params, tokens, cache, slot, length):
     return last, {"k": new_k, "v": new_v}
 
 
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def prefill_batch(cfg: GPTConfig, params, tokens, cache, slots, lengths):
+    """Prefill N prompts into N distinct cache slots in ONE dispatch.
+
+    tokens: [N, S_bucket] (padded); slots/lengths: [N]. The serving engine
+    admits queued requests in ladder-sized groups so a burst of arrivals
+    costs one host↔device round trip per group instead of one per request
+    (prefill RTTs dominate TTFT once decode is window-fused).
+    → (last-token logits [N, V] fp32, updated cache).
+    """
+    N, S = tokens.shape
+    x = params["wte"].astype(cfg.dtype)[tokens]            # [N, S, D]
+    pos = jnp.broadcast_to(jnp.arange(S)[None, :], (N, S))
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def body(x, inputs):
+        layer, k_cache_l, v_cache_l = inputs
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        q, k, v = _qkv(h, layer, cfg)
+        q = _rotary_pos(q, cfg.rotary_dim, pos)
+        k = _rotary_pos(k, cfg.rotary_dim, pos)
+        logits = jnp.einsum("bshk,bthk->bhst", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        causal = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        logits = jnp.where(causal[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn,
+                           layer["wo"].astype(cfg.dtype))
+        x = _mlp(x, layer, cfg)
+        # Scatter each row's prompt K/V into its slot (distinct slots).
+        k_cache_l = k_cache_l.at[slots, :S].set(k.astype(cfg.dtype))
+        v_cache_l = v_cache_l.at[slots, :S].set(v.astype(cfg.dtype))
+        return x, (k_cache_l, v_cache_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (stacked, cache["k"], cache["v"]))
+    logits = _head(params, cfg, x)                         # [N, S, V]
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return last, {"k": new_k, "v": new_v}
+
+
 def _decode_once(cfg: GPTConfig, params, tokens, cache, positions):
     """Shared single-token forward: all slots advance one position.
     → (logits [B, V] fp32, updated cache). Traced inside decode_step and

@@ -1,0 +1,224 @@
+"""MoE-GPT: a Mixtral-class sparse decoder model family.
+
+Net-new vs the reference (SURVEY §2.4: no expert parallelism anywhere in
+`/root/reference`): every transformer block's dense MLP is replaced by a
+GShard-style top-2 MoE layer (ray_tpu.ops.moe), giving a third model
+family next to GPT (models/gpt.py) and Llama (models/llama.py).
+
+TPU-first layout: attention params and per-layer MoE expert stacks both
+carry a leading scanned `layers` axis, and expert weights carry the
+logical `expert` axis so the mesh's `ep` dimension shards expert compute —
+XLA derives the token all-to-all from the dispatch/combine einsum
+shardings. The load-balance aux loss is accumulated through the layer
+scan and added to the CE loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import gpt as _gpt
+from ray_tpu.models.gpt import _attention, _layer_norm, _rotary
+from ray_tpu.ops.moe import MoEConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEGPTConfig:
+    vocab_size: int = 50304
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072                  # per-expert FFN width
+    n_experts: int = 8
+    capacity_factor: float = 1.5
+    aux_coef: float = 0.01            # load-balance loss weight
+    max_seq: int = 1024
+    rotary_dim: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    tie_embeddings: bool = True
+    remat: bool = False
+    attn_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def moe_cfg(self) -> MoEConfig:
+        return MoEConfig(self.d_model, self.d_ff, self.n_experts,
+                         capacity_factor=self.capacity_factor,
+                         dtype=self.dtype, param_dtype=self.param_dtype)
+
+    @classmethod
+    def tiny(cls, **kw) -> "MoEGPTConfig":
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_seq", 128)
+        kw.setdefault("rotary_dim", 4)
+        kw.setdefault("d_model", 64)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 8)
+        kw.setdefault("d_ff", 128)
+        kw.setdefault("n_experts", 4)
+        return cls(**kw)
+
+    @classmethod
+    def moe_8x350m(cls, **kw) -> "MoEGPTConfig":
+        """~1.9B total / ~350M active params (Mixtral-style sparsity)."""
+        kw.setdefault("remat", True)
+        return cls(d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+                   n_experts=8, **kw)
+
+    _REGISTRY = ("tiny", "moe_8x350m")
+
+    @classmethod
+    def by_name(cls, name: str, **kw) -> "MoEGPTConfig":
+        if name not in cls._REGISTRY:
+            raise KeyError(f"unknown model {name!r}; one of {cls._REGISTRY}")
+        return getattr(cls, name)(**kw)
+
+
+def param_specs(cfg: MoEGPTConfig) -> dict[str, dict[str, Any]]:
+    """Attention/embed specs follow gpt.py; the MLP is replaced by
+    per-layer expert stacks [L, E, ...] with the `expert` logical axis."""
+    D, F, L, E = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.n_experts
+    base = _gpt.param_specs(_as_gpt_cfg(cfg))
+    for k in ("w_up", "b_up", "w_down", "b_down"):
+        del base[k]
+    norm = lambda *s: {"init": "normal", "scale": 0.02, "shape": s}
+    resid = lambda *s: {"init": "normal",
+                        "scale": 0.02 / math.sqrt(2 * L), "shape": s}
+    zeros = lambda *s: {"init": "zeros", "shape": s}
+    base.update({
+        "wg": {**norm(L, D, E), "axes": ("layers", "embed", None)},
+        "moe_w_up": {**norm(L, E, D, F),
+                     "axes": ("layers", "expert", "embed", "mlp")},
+        "moe_b_up": {**zeros(L, E, F), "axes": ("layers", "expert", "mlp")},
+        "moe_w_down": {**resid(L, E, F, D),
+                       "axes": ("layers", "expert", "mlp", "embed")},
+        "moe_b_down": {**zeros(L, E, D),
+                       "axes": ("layers", "expert", "embed")},
+    })
+    return base
+
+
+def logical_axes(cfg: MoEGPTConfig) -> dict[str, tuple]:
+    return {k: v["axes"] for k, v in param_specs(cfg).items()}
+
+
+def _as_gpt_cfg(cfg: MoEGPTConfig) -> _gpt.GPTConfig:
+    """The attention/embedding half of the model is exactly GPT."""
+    return _gpt.GPTConfig(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads, d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq, rotary_dim=cfg.rotary_dim, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype, tie_embeddings=cfg.tie_embeddings,
+        remat=cfg.remat, attn_impl=cfg.attn_impl)
+
+
+def init_params(cfg: MoEGPTConfig, rng: jax.Array) -> dict[str, jax.Array]:
+    specs = param_specs(cfg)
+    keys = jax.random.split(rng, len(specs))
+    params = {}
+    for key, (name, spec) in zip(keys, sorted(specs.items())):
+        if spec["init"] == "normal":
+            params[name] = jax.random.normal(
+                key, spec["shape"], cfg.param_dtype) * spec["scale"]
+        elif spec["init"] == "ones":
+            params[name] = jnp.ones(spec["shape"], cfg.param_dtype)
+        else:
+            params[name] = jnp.zeros(spec["shape"], cfg.param_dtype)
+    return params
+
+
+_ATTN_KEYS = ("ln1_scale", "ln1_bias", "wq", "wk", "wv", "wo",
+              "ln2_scale", "ln2_bias")
+_MOE_KEYS = ("wg", "moe_w_up", "moe_b_up", "moe_w_down", "moe_b_down")
+
+
+def _moe_mlp_layer(h: jax.Array, layer: dict, cfg: MoEGPTConfig):
+    """h [B, S, D] (post-ln2) → (y [B, S, D], aux scalar): this layer's
+    expert stack routed through the shared ops.moe.moe_mlp (one copy of
+    the routing/aux math in the codebase)."""
+    from ray_tpu.ops.moe import moe_mlp
+
+    return moe_mlp(h, {
+        "wg": layer["wg"],
+        "w_up": layer["moe_w_up"],
+        "b_up": layer["moe_b_up"],
+        "w_down": layer["moe_w_down"],
+        "b_down": layer["moe_b_down"],
+    }, cfg.moe_cfg())
+
+
+def _moe_block(x, layer, cfg: MoEGPTConfig, mesh=None):
+    gcfg = _as_gpt_cfg(cfg)
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = _rotary(q, cfg.rotary_dim)
+    k = _rotary(k, cfg.rotary_dim)
+    attn = _attention(q, k, v, gcfg, mesh=mesh)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn,
+                       layer["wo"].astype(cfg.dtype))
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    y, aux = _moe_mlp_layer(h, layer, cfg)
+    return x + y, aux
+
+
+def forward_hidden(params, tokens, cfg: MoEGPTConfig, mesh=None):
+    """→ (hidden [B, S, D], mean aux loss over layers)."""
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    stacked = {k: params[k] for k in _ATTN_KEYS + _MOE_KEYS}
+    block_fn = lambda x, layer: _moe_block(x, layer, cfg, mesh)
+
+    def body(carry, layer):
+        x, aux_sum = carry
+        fn = jax.checkpoint(block_fn) if cfg.remat else block_fn
+        x, aux = fn(x, layer)
+        return (x, aux_sum + aux), None
+
+    (x, aux_sum), _ = jax.lax.scan(
+        body, (x, jnp.float32(0.0)), stacked)
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    return x, aux_sum / cfg.n_layers
+
+
+def forward(params, tokens, cfg: MoEGPTConfig, mesh=None):
+    """tokens [B, S] → (logits [B, S, V] fp32, aux scalar)."""
+    x, aux = forward_hidden(params, tokens, cfg, mesh)
+    head = (params["lm_head"] if not cfg.tie_embeddings
+            else params["wte"].T)
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, aux
+
+
+def loss_fn(params, tokens, targets, cfg: MoEGPTConfig, mesh=None):
+    """Next-token CE + aux_coef * load-balance loss."""
+    logits, aux = forward(params, tokens, cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(ce) + cfg.aux_coef * aux
+
+
+def num_params(cfg: MoEGPTConfig) -> tuple[int, int]:
+    """→ (total, active-per-token) parameter counts. Active counts top-2
+    of E experts per MoE layer."""
+    specs = param_specs(cfg)
+    total = sum(int(jnp.prod(jnp.array(s["shape"])))
+                for s in specs.values())
+    expert = sum(int(jnp.prod(jnp.array(specs[k]["shape"])))
+                 for k in ("moe_w_up", "moe_b_up", "moe_w_down",
+                           "moe_b_down"))
+    active = total - expert + (expert * 2) // cfg.n_experts
+    return total, active
+
+
+__all__ = ["MoEGPTConfig", "forward", "forward_hidden", "init_params",
+            "logical_axes", "loss_fn", "num_params", "param_specs"]

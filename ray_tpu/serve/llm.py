@@ -193,36 +193,72 @@ class LLMEngine:
         self._rng_key, sub = jax.random.split(self._rng_key)
         return int(sample_token(logits_row, temperature=temperature, key=sub))
 
+    _PREFILL_LADDER = (8, 4, 2)
+
     def _admit(self) -> None:
-        """Prefill queued requests into free slots."""
+        """Prefill queued requests into free slots. Same-bucket arrivals
+        are admitted in ladder-sized GROUPS via one prefill_batch dispatch
+        each — a burst of N requests costs ~log(N) round trips instead of
+        N (prefill RTTs dominate TTFT once decode is window-fused)."""
         import jax.numpy as jnp
 
-        from ray_tpu.models.decode import prefill
+        from ray_tpu.models.decode import prefill, prefill_batch
 
-        for slot in range(self.n_slots):
-            if self.slot_req[slot] is not None:
-                continue
+        free = [s for s in range(self.n_slots) if self.slot_req[s] is None]
+        reqs: list[GenRequest] = []
+        while len(reqs) < len(free):
             try:
-                req = self.pending.get_nowait()
+                reqs.append(self.pending.get_nowait())
             except queue.Empty:
-                return
-            n = len(req.prompt_ids)
-            bucket = self._bucket(n)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :n] = req.prompt_ids
-            try:
+                break
+        if not reqs:
+            return
+        by_bucket: dict[int, list[GenRequest]] = {}
+        for req in reqs:
+            by_bucket.setdefault(
+                self._bucket(len(req.prompt_ids)), []).append(req)
+        slot_iter = iter(free)
+        for bucket, group in by_bucket.items():
+            while group:
+                n = next((k for k in self._PREFILL_LADDER
+                          if k <= len(group)), 1)
+                chunk = group[:n]
+                group = group[n:]
+                slots = [next(slot_iter) for _ in chunk]
+                self._prefill_chunk(bucket, chunk, slots, prefill,
+                                    prefill_batch, jnp)
+
+    def _prefill_chunk(self, bucket, chunk, slots, prefill, prefill_batch,
+                       jnp) -> None:
+        n = len(chunk)
+        padded = np.zeros((n, bucket), np.int32)
+        lengths = np.zeros(n, np.int32)
+        for i, req in enumerate(chunk):
+            lengths[i] = len(req.prompt_ids)
+            padded[i, :lengths[i]] = req.prompt_ids
+        try:
+            if n == 1:
                 last_logits, self.cache = prefill(
                     self.cfg, self.params, jnp.asarray(padded), self.cache,
-                    jnp.int32(slot), jnp.int32(n))
-            except Exception as e:
+                    jnp.int32(slots[0]), jnp.int32(int(lengths[0])))
+                last_logits = np.asarray(last_logits)[None, :]
+            else:
+                last_logits, self.cache = prefill_batch(
+                    self.cfg, self.params, jnp.asarray(padded), self.cache,
+                    jnp.asarray(np.asarray(slots, np.int32)),
+                    jnp.asarray(lengths))
+                last_logits = np.asarray(last_logits)
+        except Exception as e:
+            for req in chunk:
                 req.error = f"prefill failed: {e!r}"
                 req.done.set()
-                continue
-            tok = self._sample(np.asarray(last_logits), req.temperature)
+            return
+        for i, (req, slot) in enumerate(zip(chunk, slots)):
+            tok = self._sample(last_logits[i], req.temperature)
             with self._lock:
                 self.slot_req[slot] = req
             self.tokens[slot] = tok
-            self.positions[slot] = n
+            self.positions[slot] = int(lengths[i])
             self.temps[slot] = req.temperature
             if self._emit(req, tok):
                 self._release(slot)

@@ -495,3 +495,51 @@ class TestStreamingActorPool:
         vals = ray_tpu.get(refs[::250], timeout=60)
         assert all(v == [0, 1] for v in vals)
         assert wall < 120, f"1k blocks took {wall:.1f}s"
+
+
+class TestMoreOpBreadth:
+    """Round-4 surface widening: column selection/renaming, index splits,
+    train/test split, std/unique/show (ref: dataset.py:141 surface)."""
+
+    def test_select_drop_rename(self, cluster):
+        ds = rd.from_items(
+            [{"a": i, "b": 2 * i, "c": 3 * i} for i in range(8)],
+            parallelism=2)
+        sel = ds.select_columns(["a", "c"]).take_all()
+        assert set(sel[0]) == {"a", "c"}
+        drp = ds.drop_columns(["b"]).take_all()
+        assert set(drp[0]) == {"a", "c"}
+        ren = ds.rename_columns({"a": "alpha"}).take_all()
+        assert set(ren[0]) == {"alpha", "b", "c"}
+        assert [r["alpha"] for r in ren] == list(range(8))
+        with pytest.raises(Exception):
+            ds.select_columns(["nope"]).take_all()
+
+    def test_split_at_indices(self, cluster):
+        ds = rd.from_items([{"a": i} for i in range(10)], parallelism=3)
+        p1, p2, p3 = ds.split_at_indices([3, 7])
+        assert [r["a"] for r in p1.take_all()] == [0, 1, 2]
+        assert [r["a"] for r in p2.take_all()] == [3, 4, 5, 6]
+        assert [r["a"] for r in p3.take_all()] == [7, 8, 9]
+        with pytest.raises(ValueError):
+            ds.split_at_indices([5, 2])
+
+    def test_train_test_split(self, cluster):
+        ds = rd.from_items([{"a": i} for i in range(20)], parallelism=4)
+        train, test = ds.train_test_split(0.25)
+        assert train.count() == 15 and test.count() == 5
+        assert [r["a"] for r in test.take_all()] == [15, 16, 17, 18, 19]
+        tr_s, te_s = ds.train_test_split(0.25, shuffle=True, seed=3)
+        assert tr_s.count() == 15 and te_s.count() == 5
+        got = sorted(r["a"] for r in tr_s.take_all() + te_s.take_all())
+        assert got == list(range(20))
+
+    def test_std_unique_show(self, cluster, capsys):
+        ds = rd.from_items(
+            [{"v": float(x)} for x in [1, 1, 2, 2, 3, 3]], parallelism=2)
+        assert ds.unique("v") == [1.0, 2.0, 3.0]
+        assert ds.std("v") == pytest.approx(np.std(
+            [1, 1, 2, 2, 3, 3], ddof=1))
+        ds.show(2)
+        outp = capsys.readouterr().out
+        assert outp.count("\n") == 2

@@ -87,6 +87,45 @@ class TestDecodePath:
         assert ra.out_ids == a_alone
         assert rb.out_ids == b_alone
 
+    def test_prefill_batch_matches_sequential(self, params):
+        """One batched multi-slot prefill produces the same last-token
+        logits and KV cache as N sequential single-slot prefills."""
+        from ray_tpu.models.decode import prefill_batch
+
+        rng = np.random.default_rng(5)
+        prompts = [list(rng.integers(0, CFG.vocab_size, n))
+                   for n in (3, 7, 5)]
+        bucket = 8
+        padded = np.zeros((3, bucket), np.int32)
+        lengths = np.array([len(p) for p in prompts], np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, :len(p)] = p
+
+        seq_cache = init_kv_cache(CFG, 4, 32)
+        seq_logits = []
+        for i, p in enumerate(prompts):
+            row = np.zeros((1, bucket), np.int32)
+            row[0, :len(p)] = p
+            last, seq_cache = prefill(
+                CFG, params, jnp.asarray(row), seq_cache,
+                jnp.int32(i + 1), jnp.int32(len(p)))
+            seq_logits.append(np.asarray(last))
+
+        bat_cache = init_kv_cache(CFG, 4, 32)
+        bat_logits, bat_cache = prefill_batch(
+            CFG, params, jnp.asarray(padded), bat_cache,
+            jnp.asarray(np.array([1, 2, 3], np.int32)),
+            jnp.asarray(lengths))
+        np.testing.assert_allclose(
+            np.asarray(bat_logits), np.stack(seq_logits), rtol=2e-4,
+            atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(bat_cache["k"]), np.asarray(seq_cache["k"]),
+            rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(bat_cache["v"]), np.asarray(seq_cache["v"]),
+            rtol=2e-4, atol=2e-4)
+
     def test_sample_token_temperature(self):
         logits = jnp.asarray([0.0, 10.0, 0.0, 0.0])
         assert int(sample_token(logits)) == 1

@@ -1,0 +1,93 @@
+"""MoE-GPT model family: routing correctness, learning, EP-sharded step.
+
+Net-new vs the reference (no expert parallelism in /root/reference —
+SURVEY §2.4); mirrors the reference's per-model test style (shape/finite
+checks + a few-step learning assertion).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import moe_gpt
+from ray_tpu.models.moe_gpt import MoEGPTConfig
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return MoEGPTConfig.tiny(dtype=jnp.float32)
+
+
+class TestMoEGPT:
+    def test_forward_shapes_and_aux(self, cfg):
+        params = moe_gpt.init_params(cfg, jax.random.key(0))
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)))
+        logits, aux = jax.jit(
+            lambda p, t: moe_gpt.forward(p, t, cfg))(params, toks)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+        # Perfectly balanced routing gives aux == 1; early training sits
+        # near it and must stay strictly positive and finite.
+        assert 0.5 < float(aux) < float(cfg.n_experts)
+
+    def test_num_params_sparse_vs_active(self, cfg):
+        total, active = moe_gpt.num_params(cfg)
+        assert total > active  # top-2 of 4 experts → roughly half the FFN
+        dense_equiv = total - (total - active) * 2  # loose sanity bound
+        assert active < total and active > dense_equiv // 2
+
+    def test_loss_decreases(self, cfg):
+        params = moe_gpt.init_params(cfg, jax.random.key(0))
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(0, 32, (4, 32)))
+        tgts = jnp.roll(toks, -1, axis=1)
+
+        @jax.jit
+        def step(params, opt_state):
+            loss, grads = jax.value_and_grad(moe_gpt.loss_fn)(
+                params, toks, tgts, cfg)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        first = None
+        for _ in range(25):
+            params, opt_state, loss = step(params, opt_state)
+            first = float(loss) if first is None else first
+        assert float(loss) < first - 0.5, (first, float(loss))
+
+    def test_ep_sharded_training_step(self, cfg):
+        """dp×ep mesh: expert weights shard over `ep`, one jitted training
+        step executes with sharded params and a data-sharded batch."""
+        from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+        from ray_tpu.parallel.sharding import shard_tree, tree_to_shardings
+
+        n = len(jax.devices())
+        mesh = make_mesh(MeshConfig(dp=n // 2, ep=2, fsdp=1, tp=1),
+                         devices=jax.devices())
+        params = moe_gpt.init_params(cfg, jax.random.key(0))
+        shardings = tree_to_shardings(moe_gpt.logical_axes(cfg), mesh)
+        with mesh:
+            sharded = shard_tree(params, shardings)
+            opt = optax.adam(1e-2)
+            opt_state = opt.init(sharded)
+            toks = jnp.asarray(
+                np.random.default_rng(1).integers(0, 32, (8, 16)))
+            tgts = jnp.roll(toks, -1, axis=1)
+
+            @jax.jit
+            def step(params, opt_state, toks, tgts):
+                loss, grads = jax.value_and_grad(moe_gpt.loss_fn)(
+                    params, toks, tgts, cfg)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state, loss
+
+            sharded, opt_state, loss = step(sharded, opt_state, toks, tgts)
+        assert np.isfinite(float(loss))
+        # Expert stacks really are partitioned over the ep axis.
+        spec = shardings["moe_w_up"].spec
+        assert "ep" in str(spec), spec
