@@ -18,6 +18,7 @@ from ray_tpu.rllib.env import (
 )
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.ars import ARS, ARSConfig
+from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.es import ES, ESConfig
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.connectors import (
@@ -54,6 +55,7 @@ __all__ = [
     "APPO", "APPOConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
     "Connector", "ConnectorPipeline", "MeanStdFilter", "ClipActions",
     "BC", "MARWIL", "ES", "ESConfig", "ARS", "ARSConfig", "PG", "PGConfig",
+    "DDPPO", "DDPPOConfig",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",

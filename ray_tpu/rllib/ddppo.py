@@ -1,0 +1,247 @@
+"""DDPPO: decentralized distributed PPO.
+
+Parity: `/root/reference/rllib/algorithms/ddppo/` — no central learner.
+Every rollout worker owns a full policy + optimizer, computes gradients on
+its OWN samples, and all-reduces them with its peers per minibatch; the
+driver only coordinates rounds and aggregates metrics. In the reference
+the allreduce is torch.distributed among the rollout workers; here it is
+the host collective plane (ray_tpu.utils.collective — the Gloo-role
+backend), while each worker's loss/grad step is a jitted JAX program.
+
+Workers start from identical seed-initialized params and apply identical
+(all-reduced) updates with identical optimizer state, so their policies
+stay bitwise-synchronized without ever shipping weights — the DDPPO
+property that removes the learner bottleneck.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.ppo import PPOConfig
+
+
+class DDPPOWorker:
+    """One decentralized learner: samples, computes GAE, and SGDs with
+    gradient allreduce against the peer group."""
+
+    def __init__(self, env, *, rank: int, world_size: int,
+                 group_name: str, num_envs: int, fragment: int,
+                 hiddens, conv, seed: int, gamma: float, lambda_: float,
+                 lr: float, clip_param: float, vf_clip_param: float,
+                 vf_loss_coeff: float, entropy_coeff: float,
+                 grad_clip: float, num_sgd_iter: int,
+                 sgd_minibatch_size: int,
+                 observation_filter: str | None = None,
+                 clip_actions: bool = False):
+        import jax
+        import jax.flatten_util  # noqa: F401  (registers the submodule)
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib import sample_batch as sb
+        from ray_tpu.rllib.ppo_core import PPOHyperparams, ppo_loss
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+        from ray_tpu.utils import collective
+
+        jax.config.update("jax_platforms", "cpu")
+        self._sb = sb
+        self.rank = rank
+        self.world_size = world_size
+        self.gamma, self.lambda_ = gamma, lambda_
+        self.num_sgd_iter = num_sgd_iter
+        self.mb = sgd_minibatch_size
+        # Same POLICY seed everywhere (sync start), different ENV seed
+        # per rank (decorrelated samples).
+        self.sampler = RolloutWorker(
+            env, num_envs=num_envs, seed=seed,
+            env_seed=seed + 1000 * (rank + 1),
+            hiddens=hiddens, conv=conv,
+            observation_filter=observation_filter,
+            clip_actions=clip_actions,
+            rollout_fragment_length=fragment)
+        self._master_filter = {"count": 0.0, "mean": 0.0, "m2": 0.0}
+        self.policy = self.sampler.policy
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr))
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self._rng = np.random.default_rng(seed + rank)
+        hp = PPOHyperparams(clip_param, vf_clip_param, vf_loss_coeff,
+                            entropy_coeff)
+        pol = self.policy
+        flat0, self._unravel = jax.flatten_util.ravel_pytree(
+            self.policy.params)
+        self._grad_dim = flat0.shape[0]
+
+        def grad_fn(params, batch):
+            (loss, _info), grads = jax.value_and_grad(
+                ppo_loss, argnums=2, has_aux=True)(pol, hp, params, batch)
+            flat, _ = jax.flatten_util.ravel_pytree(grads)
+            return loss, flat
+
+        self._grad = jax.jit(grad_fn)
+
+        def apply_fn(params, opt_state, flat_grads):
+            grads = self._unravel(flat_grads)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply = jax.jit(apply_fn, donate_argnums=(0, 1))
+        collective.init_collective_group(world_size, rank, group_name)
+        self._collective = collective
+        self._group = group_name
+
+    def train_round(self) -> dict:
+        """One DDPPO round: sample → GAE → num_sgd_iter epochs of
+        minibatch SGD with gradient allreduce. Returns worker metrics."""
+        import jax.numpy as jnp
+
+        sb = self._sb
+        batch = self.sampler.sample()
+        # Decentralized fleet filter sync: every rank allgathers all
+        # deltas and applies the SAME count-weighted merge, so filter
+        # states stay identical across workers without a coordinator.
+        if self.sampler.obs_filter is not None:
+            from ray_tpu.rllib.connectors import MeanStdFilter
+
+            deltas = self._collective.allgather(
+                self.sampler.pop_filter_delta(), self._group)
+            self._master_filter = MeanStdFilter.merged_state(
+                [self._master_filter] + [d[0] for d in deltas if d])
+            self.sampler.set_filter_state([self._master_filter])
+        last_values = batch.pop("last_values")
+        batch.pop("last_obs", None)
+        batch = sb.flatten_time_major(sb.compute_gae(
+            batch, last_values, gamma=self.gamma, lam=self.lambda_))
+        adv = batch[sb.ADVANTAGES]
+        batch[sb.ADVANTAGES] = (
+            (adv - adv.mean()) / max(1e-8, adv.std())).astype(np.float32)
+        n_mb = max(1, batch.count // self.mb)
+        loss = None
+        for _ in range(self.num_sgd_iter):
+            shuffled = batch.shuffle(self._rng)
+            for i in range(n_mb):
+                mb = {k: jnp.asarray(v[i * self.mb:(i + 1) * self.mb])
+                      for k, v in shuffled.items()}
+                loss, flat = self._grad(self.policy.params, mb)
+                mean = self._collective.allreduce(
+                    np.asarray(flat), self._group) / float(self.world_size)
+                self.policy.params, self.opt_state = self._apply(
+                    self.policy.params, self.opt_state, jnp.asarray(mean))
+        m = self.sampler.metrics()
+        return {"loss": float(loss), "steps": batch.count,
+                "episode_return_mean": m["episode_return_mean"]}
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def weights_digest(self) -> str:
+        import hashlib
+        import jax
+
+        flat, _ = jax.flatten_util.ravel_pytree(self.policy.params)
+        return hashlib.sha256(
+            np.asarray(flat).tobytes()).hexdigest()[:16]
+
+
+class DDPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_rollout_workers = 2
+
+
+class DDPPO(Algorithm):
+    def __init__(self, config: DDPPOConfig):
+        if config.num_rollout_workers < 2:
+            raise ValueError("DDPPO is decentralized: needs >= 2 workers")
+        # The base WorkerSet stays a minimal local stub; DDPPO's workers
+        # are full decentralized learners, not samplers for a central
+        # learner.
+        self._world = config.num_rollout_workers
+        self._envs_per_learner = config.num_envs_per_worker
+        config = config.copy()
+        config.num_rollout_workers = 0
+        config.num_envs_per_worker = 1
+        super().__init__(config)
+
+    @classmethod
+    def get_default_config(cls) -> DDPPOConfig:
+        return DDPPOConfig()
+
+    def setup(self) -> None:
+        import uuid
+
+        cfg: DDPPOConfig = self.config
+        # Unique per-build group: a reused id() must never resolve to a
+        # stale rendezvous actor with a different world_size.
+        self._group_name = f"ddppo:{uuid.uuid4().hex[:12]}"
+        worker_cls = ray_tpu.remote(DDPPOWorker)
+        self._learners = [
+            worker_cls.remote(
+                cfg.env, rank=i, world_size=self._world,
+                group_name=self._group_name,
+                num_envs=self._envs_per_learner,
+                fragment=cfg.rollout_fragment_length,
+                hiddens=tuple(cfg.model_hiddens), conv=cfg.model_conv,
+                seed=cfg.env_seed, gamma=cfg.gamma, lambda_=cfg.lambda_,
+                lr=cfg.lr, clip_param=cfg.clip_param,
+                vf_clip_param=cfg.vf_clip_param,
+                vf_loss_coeff=cfg.vf_loss_coeff,
+                entropy_coeff=cfg.entropy_coeff, grad_clip=cfg.grad_clip,
+                num_sgd_iter=cfg.num_sgd_iter,
+                sgd_minibatch_size=cfg.sgd_minibatch_size,
+                observation_filter=cfg.observation_filter,
+                clip_actions=cfg.clip_actions)
+            for i in range(self._world)]
+
+    def training_step(self) -> dict:
+        rounds = ray_tpu.get(
+            [w.train_round.remote() for w in self._learners], timeout=600)
+        steps = sum(r["steps"] for r in rounds)
+        self._timesteps_total += steps
+        returns = [r["episode_return_mean"] for r in rounds
+                   if r["episode_return_mean"] is not None]
+        return {
+            "loss": float(np.mean([r["loss"] for r in rounds])),
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else None),
+            "steps_this_iter": steps,
+        }
+
+    def get_weights(self):
+        return ray_tpu.get(self._learners[0].get_weights.remote(),
+                           timeout=120)
+
+    def set_weights(self, weights) -> None:
+        raise NotImplementedError(
+            "DDPPO workers stay in sync by construction; restore by "
+            "rebuilding the algorithm from a checkpointed worker-0 state")
+
+    def weights_digests(self) -> list[str]:
+        """Bitwise-sync check across the decentralized learners."""
+        return ray_tpu.get(
+            [w.weights_digest.remote() for w in self._learners],
+            timeout=120)
+
+    def stop(self) -> None:
+        for w in self._learners:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        # The rendezvous actor is detached: reap it or it outlives the
+        # algorithm for the life of the cluster.
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(
+                f"raytpu_collective:{self._group_name}"))
+        except Exception:
+            pass
+        super().stop()
+
+
+DDPPOConfig.algo_class = DDPPO
+
+__all__ = ["DDPPO", "DDPPOConfig", "DDPPOWorker"]

@@ -40,6 +40,11 @@ class DQNConfig(AlgorithmConfig):
         self.num_atoms = 1
         self.v_min = -10.0
         self.v_max = 10.0
+        # Dueling heads (ref: dqn dueling option): Q = V + A - mean(A).
+        self.dueling = False
+        # n-step targets (ref: dqn n_step option): fold n transitions into
+        # one with gamma^h bootstrap.
+        self.n_step = 1
 
 
 class DQN(Algorithm):
@@ -54,11 +59,33 @@ class DQN(Algorithm):
         obs_dim = int(np.prod(env.observation_space.shape))
         self.n_actions = env.action_space.n
         self.atoms = max(1, cfg.num_atoms)
-        sizes = (obs_dim, *cfg.model_hiddens, self.n_actions * self.atoms)
-        self.params = _init_mlp(jax.random.key(cfg.env_seed), sizes,
-                                scale_last=0.01)
+        if cfg.dueling and self.atoms > 1:
+            raise ValueError("dueling + distributional not supported "
+                             "together; pick one")
+        key = jax.random.key(cfg.env_seed)
+        if cfg.dueling:
+            kt, ka, kv = jax.random.split(key, 3)
+            hid = cfg.model_hiddens[-1]
+            self.params = {
+                "torso": _init_mlp(kt, (obs_dim, *cfg.model_hiddens),
+                                   scale_last=1.0),
+                "adv": _init_mlp(ka, (hid, self.n_actions),
+                                 scale_last=0.01),
+                "val": _init_mlp(kv, (hid, 1), scale_last=0.01),
+            }
+        else:
+            sizes = (obs_dim, *cfg.model_hiddens,
+                     self.n_actions * self.atoms)
+            self.params = _init_mlp(key, sizes, scale_last=0.01)
         if self.atoms > 1:
             self._z = jnp.linspace(cfg.v_min, cfg.v_max, self.atoms)
+        if cfg.n_step > 1:
+            from ray_tpu.rllib.replay_buffer import NStepAccumulator
+
+            self._nstep = NStepAccumulator(
+                cfg.n_step, cfg.gamma, env.num_envs)
+        else:
+            self._nstep = None
         self.target_params = jax.tree.map(jnp.copy, self.params)
         self.optimizer = optax.adam(cfg.lr)
         self.opt_state = self.optimizer.init(self.params)
@@ -72,7 +99,16 @@ class DQN(Algorithm):
             self._qvals = jax.jit(
                 lambda p, o: self._expected_q(self._log_dist(p, o)))
         else:
-            self._qvals = jax.jit(lambda p, o: _mlp(p, o))
+            self._qvals = jax.jit(self._q_net)
+
+    def _q_net(self, params, obs):
+        """[B, A] Q-values: plain MLP head or dueling V/A composition."""
+        if self.config.dueling:
+            h = jnp.tanh(_mlp(params["torso"], obs))
+            a = _mlp(params["adv"], h)
+            v = _mlp(params["val"], h)
+            return v + a - jnp.mean(a, axis=1, keepdims=True)
+        return _mlp(params, obs)
 
     # ---- C51 helpers (traced) ----
 
@@ -85,14 +121,17 @@ class DQN(Algorithm):
     def _expected_q(self, log_p):
         return jnp.sum(jnp.exp(log_p) * self._z, axis=-1)  # [B, A]
 
-    def _c51_project(self, p_next, rewards, dones):
-        """Categorical projection of r + gamma*z onto the fixed support
-        (C51, ref: dqn_torch_policy.py). One-hot matmuls, no scatter."""
+    def _c51_project(self, p_next, rewards, dones, gammas=None):
+        """Categorical projection of r + gamma^h * z onto the fixed
+        support (C51, ref: dqn_torch_policy.py). One-hot matmuls, no
+        scatter. `gammas` [B] supports n-step horizons (None = gamma^1)."""
         cfg: DQNConfig = self.config
         n = self.atoms
         dz = (cfg.v_max - cfg.v_min) / (n - 1)
+        g = (jnp.full_like(rewards, cfg.gamma) if gammas is None
+             else gammas)
         tz = jnp.clip(
-            rewards[:, None] + cfg.gamma * self._z[None, :]
+            rewards[:, None] + g[:, None] * self._z[None, :]
             * (1.0 - dones.astype(jnp.float32))[:, None],
             cfg.v_min, cfg.v_max)
         b = (tz - cfg.v_min) / dz                        # [B, n]
@@ -130,23 +169,27 @@ class DQN(Algorithm):
                 log_p_next_t, best[:, None, None].repeat(self.atoms, -1),
                 axis=1)[:, 0])
             m = jax.lax.stop_gradient(self._c51_project(
-                p_best, batch[sb.REWARDS], batch[sb.DONES]))
+                p_best, batch[sb.REWARDS], batch[sb.DONES],
+                batch.get("nstep_gamma")))
             ce = -jnp.sum(m * log_p_taken, axis=-1)      # [B]
             return jnp.mean(weights * ce), ce
 
         def loss_fn(params):
-            q = _mlp(params, batch[sb.OBS])
+            q = self._q_net(params, batch[sb.OBS])
             q_taken = jnp.take_along_axis(
                 q, batch[sb.ACTIONS][:, None].astype(jnp.int32), axis=1)[:, 0]
-            q_next_target = _mlp(target_params, batch[sb.NEXT_OBS])
+            q_next_target = self._q_net(target_params, batch[sb.NEXT_OBS])
             if cfg.double_q:
-                q_next_online = _mlp(params, batch[sb.NEXT_OBS])
+                q_next_online = self._q_net(params, batch[sb.NEXT_OBS])
                 best = jnp.argmax(q_next_online, axis=1)
             else:
                 best = jnp.argmax(q_next_target, axis=1)
             q_next = jnp.take_along_axis(
                 q_next_target, best[:, None], axis=1)[:, 0]
-            target = batch[sb.REWARDS] + cfg.gamma * q_next * (
+            g = batch.get("nstep_gamma")
+            if g is None:
+                g = jnp.full_like(batch[sb.REWARDS], cfg.gamma)
+            target = batch[sb.REWARDS] + g * q_next * (
                 1.0 - batch[sb.DONES].astype(jnp.float32))
             td = q_taken - jax.lax.stop_gradient(target)
             return jnp.mean(weights * td**2), td
@@ -180,13 +223,21 @@ class DQN(Algorithm):
             stored_next = np.where(
                 finished_rows.reshape((-1,) + (1,) * (next_obs.ndim - 1)),
                 env.final_obs, next_obs)
-            self.buffer.add(SampleBatch({
-                sb.OBS: obs.astype(np.float32),
-                sb.ACTIONS: actions.astype(np.int64),
-                sb.REWARDS: reward.astype(np.float32),
-                sb.DONES: done,
-                sb.NEXT_OBS: stored_next.astype(np.float32),
-            }))
+            if self._nstep is not None:
+                matured = self._nstep.push(
+                    obs.astype(np.float32), actions.astype(np.int64),
+                    reward, done, stored_next.astype(np.float32),
+                    finished_rows)
+                if matured is not None:
+                    self.buffer.add(matured)
+            else:
+                self.buffer.add(SampleBatch({
+                    sb.OBS: obs.astype(np.float32),
+                    sb.ACTIONS: actions.astype(np.int64),
+                    sb.REWARDS: reward.astype(np.float32),
+                    sb.DONES: done,
+                    sb.NEXT_OBS: stored_next.astype(np.float32),
+                }))
             worker._running_return += reward
             for i in np.nonzero(finished_rows)[0]:
                 worker.episode_returns.append(float(worker._running_return[i]))

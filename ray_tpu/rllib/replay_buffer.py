@@ -80,3 +80,61 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         prio = np.abs(td_errors) + 1e-6
         self._prio[idx] = prio**self.alpha
         self._max_prio = max(self._max_prio, float(prio.max()))
+
+
+class NStepAccumulator:
+    """Folds 1-step transitions into n-step transitions per env stream
+    (ref: rllib/utils/replay_buffers + the `n_step` option on DQN-family
+    configs): emits (obs_t, a_t, sum_k gamma^k r_{t+k}, done, obs_{t+h},
+    gamma^h) where h <= n shrinks at episode boundaries.
+
+    Vectorized envs interleave episodes, so horizons are tracked per
+    sub-env; `push` returns the rows that matured this step.
+    """
+
+    GAMMA_COL = "nstep_gamma"
+
+    def __init__(self, n: int, gamma: float, num_envs: int):
+        assert n >= 1
+        self.n = n
+        self.gamma = gamma
+        self.queues: list[list] = [[] for _ in range(num_envs)]
+
+    def push(self, obs, actions, rewards, dones, next_obs,
+             finished) -> SampleBatch | None:
+        """All args are [num_envs, ...] for ONE vector step; `finished` is
+        done|trunc (flushes the stream's queue). → matured rows or None."""
+        out: list[tuple] = []
+        for i, q in enumerate(self.queues):
+            q.append((obs[i], actions[i], float(rewards[i]),
+                      bool(dones[i]), next_obs[i]))
+            if len(q) == self.n:
+                out.append(self._fold(q))
+                q.pop(0)
+            if finished[i]:
+                while q:
+                    out.append(self._fold(q))
+                    q.pop(0)
+        if not out:
+            return None
+        cols = list(zip(*out))
+        return SampleBatch({
+            "obs": np.stack(cols[0]),
+            "actions": np.asarray(cols[1]),
+            "rewards": np.asarray(cols[2], np.float32),
+            "dones": np.asarray(cols[3]),
+            "next_obs": np.stack(cols[4]),
+            self.GAMMA_COL: np.asarray(cols[5], np.float32),
+        })
+
+    def _fold(self, q: list) -> tuple:
+        """Collapse the queue's oldest transition across its horizon."""
+        obs0, a0 = q[0][0], q[0][1]
+        r_acc, g = 0.0, 1.0
+        for (_o, _a, r, done, nxt) in q:
+            r_acc += g * r
+            g *= self.gamma
+            last_next, last_done = nxt, done
+            if done:
+                break
+        return (obs0, a0, r_acc, last_done, last_next, g)

@@ -30,14 +30,19 @@ class RolloutWorker:
                  rollout_fragment_length: int = 64,
                  observation_filter: str | None = None,
                  clip_actions: bool = False,
-                 jax_platform: str | None = None):
+                 jax_platform: str | None = None,
+                 env_seed: int | None = None):
         # Remote samplers run their small policy MLP on host CPU: per-step
         # inference on tiny batches would be dominated by TPU dispatch
         # latency, and the TPU belongs to the learner. Must happen before
         # this process's JAX backend initializes.
         if jax_platform is not None:
             jax.config.update("jax_platforms", jax_platform)
-        self.env = make_env(env, num_envs=num_envs, seed=seed)
+        # env_seed decouples sampling streams from policy init: DDPPO
+        # workers share the policy seed (sync start) but must explore
+        # decorrelated episodes.
+        self.env = make_env(env, num_envs=num_envs,
+                            seed=seed if env_seed is None else env_seed)
         self.policy = Policy(
             self.env.observation_space, self.env.action_space,
             hiddens=hiddens, conv=conv, seed=seed,

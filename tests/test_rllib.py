@@ -556,3 +556,48 @@ class TestTD3:
         algo.stop()
 
 
+
+
+class TestDQNVariants:
+    """Reference DQN options: dueling heads + n-step targets
+    (ref: rllib/algorithms/dqn dueling/n_step config)."""
+
+    def test_nstep_accumulator_folds_and_flushes(self):
+        from ray_tpu.rllib.replay_buffer import NStepAccumulator
+
+        acc = NStepAccumulator(3, 0.5, num_envs=1)
+        obs = lambda v: np.array([[v]], np.float32)
+        # Steps 0,1 queue up (no emission yet)...
+        assert acc.push(obs(0), [0], [1.0], [False], obs(1), [False]) is None
+        assert acc.push(obs(1), [1], [1.0], [False], obs(2), [False]) is None
+        # Step 2 matures step 0: r = 1 + .5 + .25, bootstrap gamma^3.
+        out = acc.push(obs(2), [0], [1.0], [False], obs(3), [False])
+        assert out.count == 1
+        assert out["rewards"][0] == pytest.approx(1.75)
+        assert out["nstep_gamma"][0] == pytest.approx(0.125)
+        assert out["next_obs"][0, 0] == 3.0
+        # Episode end flushes the rest with shrinking horizons.
+        out = acc.push(obs(3), [1], [1.0], [True], obs(4), [True])
+        assert out.count == 3
+        np.testing.assert_allclose(out["rewards"], [1.75, 1.5, 1.0])
+        assert out["dones"].all()
+
+    def test_dueling_nstep_learning(self):
+        cfg = (DQNConfig()
+               .environment("CartPole-v1", seed=0)
+               .rollouts(num_envs_per_worker=8)
+               .training(lr=1e-3, train_batch_size=512, learning_starts=1000,
+                         epsilon_timesteps=8000, target_update_freq=1000,
+                         sgd_rounds_per_step=8, prioritized_replay=True,
+                         dueling=True, n_step=3))
+        algo = cfg.build()
+        result = None
+        for _ in range(35):
+            result = algo.train()
+        assert result["loss"] is not None and np.isfinite(result["loss"])
+        assert result["episode_return_mean"] > 45, result
+
+    def test_dueling_plus_c51_rejected(self):
+        with pytest.raises(ValueError, match="dueling"):
+            (DQNConfig().environment("CartPole-v1")
+             .training(dueling=True, num_atoms=51)).build()
