@@ -220,7 +220,17 @@ def main() -> None:
         if os.environ.get("BENCH_OPT", "adamw") == "adafactor":
             optimizer = optax.adafactor(3e-4)
         else:
-            optimizer = optax.adamw(3e-4, weight_decay=0.1)
+            # Adam's first moment in bf16 (default; BENCH_MU=fp32 to
+            # ablate) halves the mu read+write HBM traffic per step —
+            # measured 83.7k → 84.7k tok/s on v5e. The second moment
+            # stays fp32: its magnitudes span too many octaves for bf16.
+            mu_env = os.environ.get("BENCH_MU", "bf16").strip()
+            if mu_env not in ("bf16", "fp32"):
+                raise ValueError(f"BENCH_MU must be bf16|fp32, got "
+                                 f"{mu_env!r}")
+            mu_dtype = {"bf16": "bfloat16", "fp32": None}[mu_env]
+            optimizer = optax.adamw(3e-4, weight_decay=0.1,
+                                    mu_dtype=mu_dtype)
         params, opt_state, step = spmd.build_training(
             cfg, mesh, optimizer, jax.random.key(0)
         )

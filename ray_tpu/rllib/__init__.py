@@ -17,6 +17,7 @@ from ray_tpu.rllib.env import (
     register_env,
 )
 from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.ars import ARS, ARSConfig
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.es import ES, ESConfig
@@ -55,7 +56,7 @@ __all__ = [
     "APPO", "APPOConfig", "TD3", "TD3Config", "DDPG", "DDPGConfig",
     "Connector", "ConnectorPipeline", "MeanStdFilter", "ClipActions",
     "BC", "MARWIL", "ES", "ESConfig", "ARS", "ARSConfig", "PG", "PGConfig",
-    "DDPPO", "DDPPOConfig",
+    "DDPPO", "DDPPOConfig", "ApexDQN", "ApexDQNConfig",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",

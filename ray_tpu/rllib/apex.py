@@ -1,0 +1,259 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Parity: `/root/reference/rllib/algorithms/apex_dqn/` (Horgan et al. 2018)
+— many exploration actors with a FIXED per-actor epsilon ladder stream
+1-step (or n-step-folded) transitions into one central prioritized replay;
+the learner samples with importance weights, updates priorities from TD
+errors, and broadcasts fresh Q-params on a cadence. Decouples acting
+throughput from learning throughput the same way IMPALA does for
+policy-gradient methods (rllib/impala.py — same bounded-in-flight
+object-plane pipeline, replay in place of V-trace).
+
+The learner reuses DQN's jitted update wholesale (double-Q / dueling /
+C51 / n-step all compose); samplers rebuild the identical Q-network from
+the shared init/apply functions (dqn.init_q_params / q_values).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class ApexSampler:
+    """Exploration actor: epsilon-greedy rollouts with a fixed epsilon."""
+
+    def __init__(self, env, *, num_envs: int, seed: int, hiddens,
+                 n_actions: int, epsilon: float, fragment: int,
+                 atoms: int = 1, dueling: bool = False,
+                 v_min: float = 0.0, v_max: float = 0.0,
+                 n_step: int = 1, gamma: float = 0.99):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.dqn import q_values
+        from ray_tpu.rllib.env import make_env
+
+        jax.config.update("jax_platforms", "cpu")
+        self.env = make_env(env, num_envs=num_envs, seed=seed)
+        self.epsilon = epsilon
+        self.fragment = fragment
+        self.n_actions = n_actions
+        z = (jnp.linspace(v_min, v_max, atoms) if atoms > 1 else None)
+        self._q = jax.jit(lambda p, o: q_values(
+            p, o, dueling=dueling, atoms=atoms, n_actions=n_actions, z=z))
+        self.params = None
+        self._rng = np.random.default_rng(seed)
+        self.obs = self.env.reset()
+        self.episode_returns: list[float] = []
+        self._running = np.zeros(self.env.num_envs, np.float64)
+        if n_step > 1:
+            from ray_tpu.rllib.replay_buffer import NStepAccumulator
+
+            self._nstep = NStepAccumulator(n_step, gamma,
+                                           self.env.num_envs)
+        else:
+            self._nstep = None
+
+    def set_weights(self, weights) -> None:
+        import jax
+
+        self.params = jax.device_put(weights)
+
+    def sample(self) -> SampleBatch:
+        """`fragment` epsilon-greedy vector steps → flat transition rows."""
+        import jax.numpy as jnp
+
+        env = self.env
+        rows: list[SampleBatch] = []
+        for _ in range(self.fragment):
+            obs_f = self.obs.astype(np.float32)
+            q = np.asarray(self._q(self.params, jnp.asarray(obs_f)))
+            greedy = q.argmax(axis=1)
+            explore = self._rng.random(env.num_envs) < self.epsilon
+            actions = np.where(
+                explore,
+                self._rng.integers(0, self.n_actions, env.num_envs),
+                greedy)
+            next_obs, reward, done, trunc = env.step(actions)
+            finished = np.logical_or(done, trunc)
+            stored_next = np.where(
+                finished.reshape((-1,) + (1,) * (next_obs.ndim - 1)),
+                env.final_obs, next_obs).astype(np.float32)
+            if self._nstep is not None:
+                matured = self._nstep.push(
+                    obs_f, actions.astype(np.int64), reward, done,
+                    stored_next, finished)
+                if matured is not None:
+                    rows.append(matured)
+            else:
+                rows.append(SampleBatch({
+                    sb.OBS: obs_f,
+                    sb.ACTIONS: actions.astype(np.int64),
+                    sb.REWARDS: reward.astype(np.float32),
+                    sb.DONES: done,
+                    sb.NEXT_OBS: stored_next,
+                }))
+            self._running += reward
+            for i in np.nonzero(finished)[0]:
+                self.episode_returns.append(float(self._running[i]))
+                self._running[i] = 0.0
+            self.obs = next_obs
+        return (SampleBatch.concat(rows) if rows
+                else SampleBatch({sb.OBS: np.zeros((0, 1), np.float32)}))
+
+    def metrics(self, window: int = 100) -> dict:
+        recent = self.episode_returns[-window:]
+        return {"episode_return_mean":
+                float(np.mean(recent)) if recent else None}
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_rollout_workers = 2
+        self.prioritized_replay = True
+        # Horgan et al. ladder: worker i explores with
+        # epsilon_base ** (1 + i/(N-1) * epsilon_alpha).
+        self.epsilon_base = 0.4
+        self.epsilon_alpha = 7.0
+        # Learner updates applied per consumed fragment.
+        self.updates_per_fragment = 4
+        # Push fresh Q-params to a sampler every N of its fragments.
+        self.broadcast_interval = 1
+        # Outstanding fragments per sampler (backpressure).
+        self.max_requests_in_flight_per_worker = 2
+
+
+class ApexDQN(DQN):
+    """Async exploration actors → central prioritized-replay learner."""
+
+    @classmethod
+    def get_default_config(cls) -> ApexDQNConfig:
+        return ApexDQNConfig()
+
+    def setup(self) -> None:
+        super().setup()          # learner state (params/target/buffer/jit)
+        cfg: ApexDQNConfig = self.config
+        n = cfg.num_rollout_workers
+        if n < 1:
+            raise ValueError("ApexDQN is distributed: num_rollout_workers "
+                             ">= 1")
+        sampler_cls = ray_tpu.remote(ApexSampler)
+        self._samplers = []
+        w = self._learner_weights()
+        self._pending: dict = {}
+        self._since_broadcast: dict = {}
+        for i in range(n):
+            eps = cfg.epsilon_base ** (
+                1 + (i / max(1, n - 1)) * cfg.epsilon_alpha)
+            s = sampler_cls.remote(
+                cfg.env, num_envs=cfg.num_envs_per_worker,
+                seed=cfg.env_seed + 7919 * (i + 1),
+                hiddens=tuple(cfg.model_hiddens),
+                n_actions=self.n_actions, epsilon=float(eps),
+                fragment=cfg.rollout_fragment_length,
+                atoms=self.atoms, dueling=cfg.dueling,
+                v_min=cfg.v_min, v_max=cfg.v_max,
+                n_step=cfg.n_step, gamma=cfg.gamma)
+            s.set_weights.remote(w)
+            self._samplers.append(s)
+            self._since_broadcast[s] = 0
+            for _ in range(cfg.max_requests_in_flight_per_worker):
+                self._pending[s.sample.remote()] = s
+
+    def _learner_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def training_step(self) -> dict:
+        import jax.numpy as jnp
+
+        cfg: ApexDQNConfig = self.config
+        losses = []
+        # Consume one matured fragment per inner round, like IMPALA.
+        for _ in range(cfg.sgd_rounds_per_step):
+            ready, _rest = ray_tpu.wait(
+                list(self._pending), num_returns=1, timeout=120)
+            if not ready:
+                raise TimeoutError("no sample fragment within 120s")
+            ref = ready[0]
+            sampler = self._pending.pop(ref)
+            try:
+                batch = ray_tpu.get(ref)
+            except Exception:
+                # Sampler died: prune it everywhere (pending refs, the
+                # broadcast table, the metrics fan-out) so the surviving
+                # pipeline neither re-polls its refs nor crashes the
+                # metrics gather at the end of this step.
+                self._since_broadcast.pop(sampler, None)
+                self._samplers = [s for s in self._samplers
+                                  if s is not sampler]
+                self._pending = {r: s for r, s in self._pending.items()
+                                 if s is not sampler}
+                if not self._samplers:
+                    raise
+                continue
+            self._since_broadcast[sampler] += 1
+            if self._since_broadcast[sampler] >= cfg.broadcast_interval:
+                sampler.set_weights.remote(self._learner_weights())
+                self._since_broadcast[sampler] = 0
+            self._pending[sampler.sample.remote()] = sampler
+            if batch.count:
+                self.buffer.add(batch)
+                self._timesteps_total += batch.count
+            if len(self.buffer) < cfg.learning_starts:
+                continue
+            for _ in range(cfg.updates_per_fragment):
+                mb = self.buffer.sample(256)
+                weights = jnp.asarray(mb.get(
+                    "weights", np.ones(mb.count, np.float32)))
+                dev = {k: jnp.asarray(v) for k, v in mb.items()
+                       if k not in ("weights", "batch_indexes")}
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.opt_state, self.target_params, dev,
+                    weights)
+                if cfg.prioritized_replay:
+                    self.buffer.update_priorities(
+                        mb["batch_indexes"], np.asarray(td))
+                losses.append(float(loss))
+                self._since_target_sync += 256
+            if self._since_target_sync >= cfg.target_update_freq:
+                import jax
+
+                self.target_params = jax.tree.map(
+                    jnp.copy, self.params)
+                self._since_target_sync = 0
+        returns = []
+        for s in list(self._samplers):
+            try:
+                m = ray_tpu.get(s.metrics.remote(), timeout=60)
+            except Exception:
+                continue
+            if m["episode_return_mean"] is not None:
+                returns.append(m["episode_return_mean"])
+        return {
+            "loss": float(np.mean(losses)) if losses else None,
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else None),
+            "buffer_size": len(self.buffer),
+            "updates_applied": len(losses),
+        }
+
+    def stop(self) -> None:
+        for s in self._samplers:
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+        super().stop()
+
+
+ApexDQNConfig.algo_class = ApexDQN
+
+__all__ = ["ApexDQN", "ApexDQNConfig", "ApexSampler"]

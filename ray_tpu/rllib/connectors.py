@@ -145,6 +145,18 @@ class MeanStdFilter(Connector):
             out["count"] = tot
         return out
 
+    @staticmethod
+    def fold_deltas(master: dict | None, worker_deltas: list) -> dict:
+        """One sync round: fold each worker's popped delta list (first
+        connector = the MeanStdFilter; None for filterless workers) into
+        `master` (None = fresh). Shared by the centralized
+        WorkerSet.sync_filters and DDPPO's decentralized allgather path
+        so the merge semantics cannot diverge."""
+        if master is None:
+            master = {"count": 0.0, "mean": 0.0, "m2": 0.0}
+        return MeanStdFilter.merged_state(
+            [master] + [d[0] for d in worker_deltas if d])
+
 
 class ClipActions(Connector):
     """Clip policy actions into the env's bounds at the env boundary

@@ -109,8 +109,8 @@ class DDPPOWorker:
 
             deltas = self._collective.allgather(
                 self.sampler.pop_filter_delta(), self._group)
-            self._master_filter = MeanStdFilter.merged_state(
-                [self._master_filter] + [d[0] for d in deltas if d])
+            self._master_filter = MeanStdFilter.fold_deltas(
+                self._master_filter, deltas)
             self.sampler.set_filter_state([self._master_filter])
         last_values = batch.pop("last_values")
         batch.pop("last_obs", None)
@@ -137,6 +137,13 @@ class DDPPOWorker:
 
     def get_weights(self):
         return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        """Checkpoint restore: every rank installs the same params and a
+        FRESH optimizer state — identical on all ranks, so the bitwise
+        sync invariant holds from the first post-restore update."""
+        self.policy.set_weights(weights)
+        self.opt_state = self.optimizer.init(self.policy.params)
 
     def weights_digest(self) -> str:
         import hashlib
@@ -216,9 +223,12 @@ class DDPPO(Algorithm):
                            timeout=120)
 
     def set_weights(self, weights) -> None:
-        raise NotImplementedError(
-            "DDPPO workers stay in sync by construction; restore by "
-            "rebuilding the algorithm from a checkpointed worker-0 state")
+        """Restore (Tune trial resume / PBT exploit): broadcast the
+        checkpointed params to every learner. Adam moments reset —
+        identically on all ranks — so sync is preserved; the optimizer
+        re-warms within a few updates."""
+        ray_tpu.get([w.set_weights.remote(weights)
+                     for w in self._learners], timeout=120)
 
     def weights_digests(self) -> list[str]:
         """Bitwise-sync check across the decentralized learners."""

@@ -22,6 +22,47 @@ from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
+def init_q_params(key, obs_dim: int, n_actions: int, hiddens,
+                  *, atoms: int = 1, dueling: bool = False):
+    """Build Q-network params (plain MLP head, C51 head, or dueling
+    V/A heads). Shared by the DQN learner and Ape-X sampler actors."""
+    if dueling and atoms > 1:
+        raise ValueError("dueling + distributional not supported "
+                         "together; pick one")
+    if dueling:
+        kt, ka, kv = jax.random.split(key, 3)
+        hid = hiddens[-1]
+        return {
+            "torso": _init_mlp(kt, (obs_dim, *hiddens), scale_last=1.0),
+            "adv": _init_mlp(ka, (hid, n_actions), scale_last=0.01),
+            "val": _init_mlp(kv, (hid, 1), scale_last=0.01),
+        }
+    return _init_mlp(key, (obs_dim, *hiddens, n_actions * atoms),
+                     scale_last=0.01)
+
+
+def q_log_dist(params, obs, n_actions: int, atoms: int):
+    """[B, A, atoms] log-probabilities of the C51 value distribution."""
+    out = _mlp(params, obs)
+    return jax.nn.log_softmax(
+        out.reshape(-1, n_actions, atoms), axis=-1)
+
+
+def q_values(params, obs, *, dueling: bool = False, atoms: int = 1,
+             n_actions: int = 0, z=None):
+    """[B, A] Q-values for any head variant (z = C51 support)."""
+    if atoms > 1:
+        return jnp.sum(
+            jnp.exp(q_log_dist(params, obs, n_actions, atoms)) * z,
+            axis=-1)
+    if dueling:
+        h = jnp.tanh(_mlp(params["torso"], obs))
+        a = _mlp(params["adv"], h)
+        v = _mlp(params["val"], h)
+        return v + a - jnp.mean(a, axis=1, keepdims=True)
+    return _mlp(params, obs)
+
+
 class DQNConfig(AlgorithmConfig):
     def __init__(self):
         super().__init__()
@@ -59,24 +100,10 @@ class DQN(Algorithm):
         obs_dim = int(np.prod(env.observation_space.shape))
         self.n_actions = env.action_space.n
         self.atoms = max(1, cfg.num_atoms)
-        if cfg.dueling and self.atoms > 1:
-            raise ValueError("dueling + distributional not supported "
-                             "together; pick one")
-        key = jax.random.key(cfg.env_seed)
-        if cfg.dueling:
-            kt, ka, kv = jax.random.split(key, 3)
-            hid = cfg.model_hiddens[-1]
-            self.params = {
-                "torso": _init_mlp(kt, (obs_dim, *cfg.model_hiddens),
-                                   scale_last=1.0),
-                "adv": _init_mlp(ka, (hid, self.n_actions),
-                                 scale_last=0.01),
-                "val": _init_mlp(kv, (hid, 1), scale_last=0.01),
-            }
-        else:
-            sizes = (obs_dim, *cfg.model_hiddens,
-                     self.n_actions * self.atoms)
-            self.params = _init_mlp(key, sizes, scale_last=0.01)
+        self.params = init_q_params(
+            jax.random.key(cfg.env_seed), obs_dim, self.n_actions,
+            tuple(cfg.model_hiddens), atoms=self.atoms,
+            dueling=cfg.dueling)
         if self.atoms > 1:
             self._z = jnp.linspace(cfg.v_min, cfg.v_max, self.atoms)
         if cfg.n_step > 1:
@@ -103,20 +130,13 @@ class DQN(Algorithm):
 
     def _q_net(self, params, obs):
         """[B, A] Q-values: plain MLP head or dueling V/A composition."""
-        if self.config.dueling:
-            h = jnp.tanh(_mlp(params["torso"], obs))
-            a = _mlp(params["adv"], h)
-            v = _mlp(params["val"], h)
-            return v + a - jnp.mean(a, axis=1, keepdims=True)
-        return _mlp(params, obs)
+        return q_values(params, obs, dueling=self.config.dueling)
 
     # ---- C51 helpers (traced) ----
 
     def _log_dist(self, params, obs):
         """[B, A, atoms] log-probabilities of the value distribution."""
-        out = _mlp(params, obs)
-        return jax.nn.log_softmax(
-            out.reshape(-1, self.n_actions, self.atoms), axis=-1)
+        return q_log_dist(params, obs, self.n_actions, self.atoms)
 
     def _expected_q(self, log_p):
         return jnp.sum(jnp.exp(log_p) * self._z, axis=-1)  # [B, A]

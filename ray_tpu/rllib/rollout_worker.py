@@ -217,10 +217,8 @@ class WorkerSet:
         if self.remote_workers:
             deltas += ray_tpu.get([w.pop_filter_delta.remote()
                                    for w in self.remote_workers])
-        if self._master_filter is None:
-            self._master_filter = {"count": 0.0, "mean": 0.0, "m2": 0.0}
-        self._master_filter = MeanStdFilter.merged_state(
-            [self._master_filter] + [d[0] for d in deltas if d])
+        self._master_filter = MeanStdFilter.fold_deltas(
+            self._master_filter, deltas)
         self.local.set_filter_state([self._master_filter])
         if self.remote_workers:
             ray_tpu.get([w.set_filter_state.remote([self._master_filter])

@@ -344,7 +344,6 @@ class TestDDPPO:
         from ray_tpu.rllib import DDPPOConfig
 
         import ray_tpu
-        from ray_tpu.rllib import DDPPOConfig
 
         cfg = (DDPPOConfig()
                .environment("CartPole-v1", seed=0)
@@ -365,6 +364,11 @@ class TestDDPPO:
         assert result["episode_return_mean"] is not None
         assert result["episode_return_mean"] > 35, result
         assert result["steps_this_iter"] == 2 * 4 * 64
+        # Restore path (Tune PBT exploit contract): broadcast keeps the
+        # fleet synced.
+        algo.set_weights(algo.get_weights())
+        algo.train()
+        assert len(set(algo.weights_digests())) == 1
         rendezvous = f"raytpu_collective:{algo._group_name}"
         ray_tpu.get_actor(rendezvous)   # alive while training
         algo.stop()
@@ -377,3 +381,41 @@ class TestDDPPO:
         with pytest.raises(ValueError, match="decentralized"):
             DDPPOConfig().environment("CartPole-v1").rollouts(
                 num_rollout_workers=1).build()
+
+
+class TestApexDQN:
+    """Ape-X (ref: rllib/algorithms/apex_dqn): exploration-ladder actors
+    stream transitions into central prioritized replay."""
+
+    def test_epsilon_ladder(self):
+        from ray_tpu.rllib import ApexDQNConfig
+
+        cfg = ApexDQNConfig()
+        n = 4
+        eps = [cfg.epsilon_base ** (1 + (i / (n - 1)) * cfg.epsilon_alpha)
+               for i in range(n)]
+        assert eps[0] == pytest.approx(0.4)
+        assert eps[-1] == pytest.approx(0.4 ** 8)
+        assert all(a > b for a, b in zip(eps, eps[1:]))
+
+    def test_apex_learns_cartpole(self, cluster):
+        from ray_tpu.rllib import ApexDQNConfig
+
+        cfg = (ApexDQNConfig()
+               .environment("CartPole-v1", seed=0)
+               .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                         rollout_fragment_length=32)
+               .training(lr=1e-3, learning_starts=1000,
+                         target_update_freq=1000, n_step=3,
+                         sgd_rounds_per_step=8, updates_per_fragment=4))
+        algo = cfg.build()
+        result = None
+        for _ in range(25):
+            result = algo.train()
+            if (result["episode_return_mean"] or 0) > 60:
+                break
+        assert result["loss"] is not None
+        assert result["buffer_size"] > 1000
+        assert result["episode_return_mean"] is not None
+        assert result["episode_return_mean"] > 40, result
+        algo.stop()
