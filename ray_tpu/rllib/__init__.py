@@ -19,7 +19,9 @@ from ray_tpu.rllib.env import (
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.ars import ARS, ARSConfig
+from ray_tpu.rllib.bandit import LinTS, LinUCB
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
+from ray_tpu.rllib.dt import DT
 from ray_tpu.rllib.es import ES, ESConfig
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.connectors import (
@@ -57,6 +59,7 @@ __all__ = [
     "Connector", "ConnectorPipeline", "MeanStdFilter", "ClipActions",
     "BC", "MARWIL", "ES", "ESConfig", "ARS", "ARSConfig", "PG", "PGConfig",
     "DDPPO", "DDPPOConfig", "ApexDQN", "ApexDQNConfig",
+    "LinUCB", "LinTS", "DT",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",

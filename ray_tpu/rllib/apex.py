@@ -27,7 +27,7 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 class ApexSampler:
     """Exploration actor: epsilon-greedy rollouts with a fixed epsilon."""
 
-    def __init__(self, env, *, num_envs: int, seed: int, hiddens,
+    def __init__(self, env, *, num_envs: int, seed: int,
                  n_actions: int, epsilon: float, fragment: int,
                  atoms: int = 1, dueling: bool = False,
                  v_min: float = 0.0, v_max: float = 0.0,
@@ -132,6 +132,14 @@ class ApexDQNConfig(DQNConfig):
 class ApexDQN(DQN):
     """Async exploration actors → central prioritized-replay learner."""
 
+    def __init__(self, config: ApexDQNConfig):
+        # The base WorkerSet stays a minimal local stub (env introspection
+        # only); Ape-X's actors are ApexSamplers, not RolloutWorkers.
+        self._n_samplers = config.num_rollout_workers
+        config = config.copy()
+        config.num_rollout_workers = 0
+        super().__init__(config)
+
     @classmethod
     def get_default_config(cls) -> ApexDQNConfig:
         return ApexDQNConfig()
@@ -139,7 +147,7 @@ class ApexDQN(DQN):
     def setup(self) -> None:
         super().setup()          # learner state (params/target/buffer/jit)
         cfg: ApexDQNConfig = self.config
-        n = cfg.num_rollout_workers
+        n = self._n_samplers
         if n < 1:
             raise ValueError("ApexDQN is distributed: num_rollout_workers "
                              ">= 1")
@@ -154,7 +162,6 @@ class ApexDQN(DQN):
             s = sampler_cls.remote(
                 cfg.env, num_envs=cfg.num_envs_per_worker,
                 seed=cfg.env_seed + 7919 * (i + 1),
-                hiddens=tuple(cfg.model_hiddens),
                 n_actions=self.n_actions, epsilon=float(eps),
                 fragment=cfg.rollout_fragment_length,
                 atoms=self.atoms, dueling=cfg.dueling,
@@ -229,10 +236,12 @@ class ApexDQN(DQN):
                 self.target_params = jax.tree.map(
                     jnp.copy, self.params)
                 self._since_target_sync = 0
+        # Batched fan-out; a dead sampler fails its own slot only.
+        refs = [(s, s.metrics.remote()) for s in list(self._samplers)]
         returns = []
-        for s in list(self._samplers):
+        for _s, ref in refs:
             try:
-                m = ray_tpu.get(s.metrics.remote(), timeout=60)
+                m = ray_tpu.get(ref, timeout=60)
             except Exception:
                 continue
             if m["episode_return_mean"] is not None:

@@ -1,0 +1,266 @@
+"""DT: Decision Transformer — offline RL as sequence modeling.
+
+Parity: `/root/reference/rllib/algorithms/dt/` (Chen et al. 2021): model
+trajectories as (return-to-go, state, action) token triples in a causal
+transformer; train with action cross-entropy on logged data; act by
+conditioning on a TARGET return and predicting the next action
+autoregressively.
+
+TPU-first: the whole window batch trains in one jitted, donated step —
+modalities embed with linear maps into a shared d_model, blocks are
+pre-norm attention + GELU MLP over the interleaved [R_t, s_t, a_t]
+sequence (causal within 3K tokens), and action logits are read at the
+state positions. Trajectory reconstruction reuses the offline
+JsonReader's write-ordered rows (same layout contract as
+rllib/marwil.py's return postprocessing).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.offline import JsonReader
+
+
+def _episodes_from_log(path: str) -> list[dict]:
+    """Write-ordered rows [num_envs, ...] → per-episode dicts with keys
+    obs [T, D], actions [T], rewards [T]. Unfinished tails are kept (they
+    still teach state→action mapping; their returns-to-go are partial)."""
+    rows = list(JsonReader(path).read_rows())
+    if not rows:
+        raise FileNotFoundError(f"no offline rows under {path!r}")
+    num_envs = len(rows[0][sb.REWARDS])
+    streams: list[dict] = [
+        {"obs": [], "actions": [], "rewards": []} for _ in range(num_envs)]
+    episodes: list[dict] = []
+    for row in rows:
+        done = np.asarray(row[sb.DONES]).astype(bool)
+        trunc = (np.asarray(row[sb.TRUNCS]).astype(bool)
+                 if sb.TRUNCS in row else np.zeros_like(done))
+        for i in range(num_envs):
+            st = streams[i]
+            st["obs"].append(np.asarray(row[sb.OBS][i], np.float32))
+            st["actions"].append(int(row[sb.ACTIONS][i]))
+            st["rewards"].append(float(row[sb.REWARDS][i]))
+            if done[i] or trunc[i]:
+                episodes.append({k: np.asarray(v) for k, v in st.items()})
+                streams[i] = {"obs": [], "actions": [], "rewards": []}
+    for st in streams:
+        if st["rewards"]:
+            episodes.append({k: np.asarray(v) for k, v in st.items()})
+    for ep in episodes:
+        ep["rtg"] = np.cumsum(ep["rewards"][::-1])[::-1].astype(np.float32)
+    return episodes
+
+
+def _init_linear(key, d_in, d_out, scale=0.02):
+    return {"w": jax.random.normal(key, (d_in, d_out), jnp.float32) * scale,
+            "b": jnp.zeros((d_out,), jnp.float32)}
+
+
+def _linear(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _ln(x):
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + 1e-5)
+
+
+class DT:
+    """Decision Transformer over logged discrete-action experience."""
+
+    def __init__(self, path: str, *, obs_dim: int, n_actions: int,
+                 context: int = 20, d_model: int = 64, n_layers: int = 2,
+                 n_heads: int = 4, lr: float = 1e-3, rtg_scale: float = 100.0,
+                 seed: int = 0):
+        self.episodes = _episodes_from_log(path)
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.K = context
+        self.d = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.rtg_scale = rtg_scale
+        self._rng = np.random.default_rng(seed)
+        # Episode sampling ∝ length (uniform over timesteps).
+        self._ep_weights = np.array([len(e["rewards"])
+                                     for e in self.episodes], np.float64)
+        self._ep_weights /= self._ep_weights.sum()
+
+        key = jax.random.key(seed)
+        ks = jax.random.split(key, 6 + 4 * n_layers)
+        d = d_model
+        self.params = {
+            "emb_rtg": _init_linear(ks[0], 1, d),
+            "emb_obs": _init_linear(ks[1], obs_dim, d),
+            "emb_act": jax.random.normal(
+                ks[2], (n_actions + 1, d), jnp.float32) * 0.02,
+            "emb_t": jax.random.normal(
+                ks[3], (4096, d), jnp.float32) * 0.02,
+            "head": _init_linear(ks[4], d, n_actions, scale=0.01),
+            "blocks": [],
+        }
+        for i in range(n_layers):
+            b = 6 + 4 * i
+            self.params["blocks"].append({
+                "qkv": _init_linear(ks[b], d, 3 * d),
+                "proj": _init_linear(ks[b + 1], d, d),
+                "up": _init_linear(ks[b + 2], d, 4 * d),
+                "down": _init_linear(ks[b + 3], 4 * d, d),
+            })
+        self.optimizer = optax.adamw(lr, weight_decay=1e-4)
+        self.opt_state = self.optimizer.init(self.params)
+
+        def forward(params, rtg, obs, act_in, timesteps, mask):
+            """rtg [B,K,1], obs [B,K,D_obs], act_in [B,K] (previous
+            actions, n_actions = 'start'), timesteps [B,K], mask [B,K]
+            (0 = left pad) → action logits at state positions [B,K,A]."""
+            B, K = act_in.shape
+            te = params["emb_t"][timesteps]                 # [B,K,d]
+            h_r = _linear(params["emb_rtg"], rtg) + te
+            h_s = _linear(params["emb_obs"], obs) + te
+            h_a = params["emb_act"][act_in] + te
+            # Interleave [R_0, s_0, a_0, R_1, ...] → [B, 3K, d].
+            x = jnp.stack([h_r, h_s, h_a], axis=2).reshape(B, 3 * K, -1)
+            L = 3 * K
+            # Causal AND key-is-valid: left-padded junk must not leak
+            # into attention context.
+            key_valid = jnp.repeat(mask.astype(bool), 3, axis=1)  # [B,L]
+            causal = (jnp.tril(jnp.ones((L, L), bool))[None]
+                      & key_valid[:, None, :])                    # [B,L,L]
+            nh = self.n_heads
+            hd = self.d // nh
+            for blk in params["blocks"]:
+                h = _ln(x)
+                qkv = _linear(blk["qkv"], h).reshape(B, L, 3, nh, hd)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                logits = jnp.einsum("blhk,bmhk->bhlm", q, k) / np.sqrt(hd)
+                logits = jnp.where(causal[:, None], logits, -1e30)
+                attn = jax.nn.softmax(logits, axis=-1)
+                o = jnp.einsum("bhlm,bmhk->blhk", attn, v).reshape(B, L, -1)
+                x = x + _linear(blk["proj"], o)
+                h = _ln(x)
+                x = x + _linear(blk["down"],
+                                jax.nn.gelu(_linear(blk["up"], h)))
+            x = _ln(x)
+            # State-position tokens predict the action taken at that step.
+            state_tok = x.reshape(B, K, 3, -1)[:, :, 1]
+            return _linear(params["head"], state_tok)       # [B,K,A]
+
+        self._forward = forward
+        self._forward_jit = jax.jit(forward)
+
+        def update(params, opt_state, batch):
+            def loss_fn(params):
+                logits = forward(params, batch["rtg"], batch["obs"],
+                                 batch["act_in"], batch["t"],
+                                 batch["mask"])
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, batch["target"][..., None], axis=-1)[..., 0]
+                return jnp.mean(nll * batch["mask"]) / jnp.maximum(
+                    jnp.mean(batch["mask"]), 1e-8)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------ data
+
+    def _sample_windows(self, batch_size: int) -> dict:
+        K = self.K
+        rtg = np.zeros((batch_size, K, 1), np.float32)
+        obs = np.zeros((batch_size, K, self.obs_dim), np.float32)
+        act_in = np.full((batch_size, K), self.n_actions, np.int64)
+        target = np.zeros((batch_size, K), np.int64)
+        ts = np.zeros((batch_size, K), np.int64)
+        mask = np.zeros((batch_size, K), np.float32)
+        eps = self._rng.choice(len(self.episodes), batch_size,
+                               p=self._ep_weights)
+        for i, e in enumerate(eps):
+            ep = self.episodes[e]
+            T = len(ep["rewards"])
+            end = self._rng.integers(1, T + 1)     # window ends at `end`
+            start = max(0, end - K)
+            n = end - start
+            sl = slice(K - n, K)                   # right-align
+            rtg[i, sl, 0] = ep["rtg"][start:end] / self.rtg_scale
+            obs[i, sl] = ep["obs"][start:end]
+            target[i, sl] = ep["actions"][start:end]
+            if n > 1:
+                act_in[i, K - n + 1: K] = ep["actions"][start:end - 1]
+            ts[i, sl] = np.arange(start, end)
+            mask[i, sl] = 1.0
+        return {"rtg": jnp.asarray(rtg), "obs": jnp.asarray(obs),
+                "act_in": jnp.asarray(act_in),
+                "target": jnp.asarray(target), "t": jnp.asarray(ts),
+                "mask": jnp.asarray(mask)}
+
+    def train_steps(self, n: int, batch_size: int = 64) -> float:
+        loss = None
+        for _ in range(n):
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state,
+                self._sample_windows(batch_size))
+        return float(loss)
+
+    # ------------------------------------------------------------ eval
+
+    def evaluate(self, env_name: str, *, target_return: float,
+                 episodes: int = 10, seed: int = 1) -> float:
+        """Rollout conditioned on `target_return` (decays by collected
+        reward — the standard DT evaluation protocol)."""
+        from ray_tpu.rllib.env import make_env
+
+        env = make_env(env_name, num_envs=1, seed=seed)
+        fwd = self._forward_jit     # compiled once per DT instance
+        K = self.K
+        returns = []
+        for _ in range(episodes):
+            obs_hist, act_hist, rtg_hist = [], [], []
+            o = env.reset()[0]
+            rtg = target_return
+            total, t0 = 0.0, 0
+            while True:
+                obs_hist.append(np.asarray(o, np.float32))
+                rtg_hist.append(rtg / self.rtg_scale)
+                n = min(len(obs_hist), K)
+                rtg_w = np.zeros((1, K, 1), np.float32)
+                obs_w = np.zeros((1, K, self.obs_dim), np.float32)
+                act_w = np.full((1, K), self.n_actions, np.int64)
+                ts_w = np.zeros((1, K), np.int64)
+                sl = slice(K - n, K)
+                rtg_w[0, sl, 0] = rtg_hist[-n:]
+                obs_w[0, sl] = obs_hist[-n:]
+                if n > 1:
+                    act_w[0, K - n + 1: K] = act_hist[-(n - 1):]
+                ts_w[0, sl] = np.arange(t0 + 1 - n, t0 + 1)
+                mask_w = np.zeros((1, K), np.float32)
+                mask_w[0, sl] = 1.0
+                logits = np.asarray(fwd(
+                    self.params, jnp.asarray(rtg_w), jnp.asarray(obs_w),
+                    jnp.asarray(act_w), jnp.asarray(ts_w),
+                    jnp.asarray(mask_w)))
+                a = int(logits[0, -1].argmax())
+                act_hist.append(a)
+                nxt, r, done, trunc = env.step(np.array([a]))
+                total += float(r[0])
+                rtg -= float(r[0])
+                o = nxt[0]
+                t0 += 1
+                if done[0] or trunc[0]:
+                    break
+            returns.append(total)
+        return float(np.mean(returns))
+
+
+__all__ = ["DT"]
