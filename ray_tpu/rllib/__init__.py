@@ -11,6 +11,7 @@ from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import (
     CartPole,
+    MemoryCue,
     Pendulum,
     VectorEnv,
     make_env,
@@ -45,6 +46,11 @@ from ray_tpu.rllib.offline import (
     collect_dataset,
 )
 from ray_tpu.rllib.policy import Policy
+from ray_tpu.rllib.recurrent import (
+    RecurrentPolicy,
+    RecurrentPPO,
+    RecurrentPPOConfig,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.td3 import DDPG, DDPGConfig, TD3, TD3Config
@@ -60,10 +66,11 @@ __all__ = [
     "BC", "MARWIL", "ES", "ESConfig", "ARS", "ARSConfig", "PG", "PGConfig",
     "DDPPO", "DDPPOConfig", "ApexDQN", "ApexDQNConfig",
     "LinUCB", "LinTS", "DT",
+    "RecurrentPPO", "RecurrentPPOConfig", "RecurrentPolicy",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
     "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",
-    "Pendulum", "make_env", "register_env",
+    "Pendulum", "MemoryCue", "make_env", "register_env",
 ]

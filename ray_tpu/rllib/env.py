@@ -230,11 +230,52 @@ class PixelCatchSmall(PixelCatch):
     SCALE = 2
 
 
+class MemoryCue(VectorEnv):
+    """Partially observable recall task: a ±1 cue is visible ONLY at the
+    first step; at the final step the agent must pick the action matching
+    the cue (+1 right, -1 wrong, 0 elsewhere). A memoryless policy can do
+    no better than 0 expected terminal reward — this env exists to prove
+    recurrent policies carry information across steps (the reference's
+    `use_lstm` model-catalog capability, rllib/models/catalog.py)."""
+
+    EP_LEN = 8
+
+    def __init__(self, num_envs: int = 1, seed: int = 0):
+        super().__init__(num_envs, seed)
+        self.observation_space = Space((2,), np.float32)
+        self.action_space = Space((), np.int64, n=2)
+        self.cue = np.zeros(num_envs, np.int64)
+        self.reset()
+
+    max_steps = EP_LEN + 2   # margin; episodes end themselves at EP_LEN
+
+    def _reset_idx(self, idx):
+        idx = np.atleast_1d(idx)
+        self.cue[idx] = self.rng.integers(0, 2, len(idx))
+
+    def _step(self, actions):
+        # VectorEnv.t counts completed steps (incremented by the base
+        # class AFTER _step and zeroed on reset) — no separate counter.
+        at_end = self.t >= self.EP_LEN - 1
+        correct = np.asarray(actions, np.int64) == self.cue
+        reward = np.where(at_end, np.where(correct, 1.0, -1.0),
+                          0.0).astype(np.float32)
+        return reward, at_end.copy()
+
+    def _obs(self):
+        o = np.zeros((self.num_envs, 2), np.float32)
+        first = self.t == 0
+        o[:, 0] = np.where(first, self.cue * 2.0 - 1.0, 0.0)
+        o[:, 1] = self.t / self.EP_LEN
+        return o
+
+
 _ENVS = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
     "PixelCatch-v0": PixelCatch,
     "PixelCatchSmall-v0": PixelCatchSmall,
+    "MemoryCue-v0": MemoryCue,
 }
 
 

@@ -1,0 +1,336 @@
+"""Recurrent (LSTM) policies + recurrent PPO.
+
+Parity: the reference model catalog's `use_lstm` wrapper
+(`/root/reference/rllib/models/catalog.py` + `models/torch/recurrent_net.py`)
+and RLlib's hidden-state plumbing (initial state per sample batch,
+time-major loss with state resets at episode boundaries). A feedforward
+policy provably cannot solve the bundled MemoryCue-v0 recall env; the
+LSTM carries the cue across steps.
+
+TPU-first: the whole BPTT update is one jitted, donated dispatch — the
+LSTM unrolls under `lax.scan` over the time axis with per-step carry
+resets from the episode-start mask (no Python-loop truncation), and the
+sampling path is a single fused step(obs, h, c) program per vector step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.policy import _init_mlp, _mlp
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+EP_START = "ep_start"          # [T, N] 1.0 where obs starts a new episode
+STATE_H = "state_h"            # [N, H] fragment-initial hidden
+STATE_C = "state_c"
+
+
+def _init_lstm(key, d_in: int, hidden: int) -> dict:
+    k1, k2 = jax.random.split(key)
+    scale = 1.0 / np.sqrt(d_in + hidden)
+    return {
+        "wx": jax.random.normal(k1, (d_in, 4 * hidden), jnp.float32) * scale,
+        "wh": jax.random.normal(k2, (hidden, 4 * hidden),
+                                jnp.float32) * scale,
+        # Forget-gate bias +1 (standard trick: remember by default).
+        "b": jnp.zeros((4 * hidden,), jnp.float32
+                       ).at[hidden:2 * hidden].set(1.0),
+    }
+
+
+def _lstm_step(cell: dict, x, h, c):
+    z = x @ cell["wx"] + h @ cell["wh"] + cell["b"]
+    H = h.shape[-1]
+    i = jax.nn.sigmoid(z[..., :H])
+    f = jax.nn.sigmoid(z[..., H:2 * H])
+    g = jnp.tanh(z[..., 2 * H:3 * H])
+    o = jax.nn.sigmoid(z[..., 3 * H:])
+    c2 = f * c + i * g
+    return o * jnp.tanh(c2), c2
+
+
+class RecurrentPolicy:
+    """obs → dense embed (tanh) → LSTM → pi/vf heads, with explicit
+    (h, c) threading. Discrete and diagonal-gaussian action heads."""
+
+    def __init__(self, obs_space, action_space, *, embed: int = 64,
+                 lstm_size: int = 64, seed: int = 0):
+        self.obs_space = obs_space
+        self.action_space = action_space
+        self.discrete = action_space.discrete
+        self.hidden = lstm_size
+        act_dim = (action_space.n if self.discrete
+                   else int(np.prod(action_space.shape)))
+        obs_dim = int(np.prod(obs_space.shape))
+        ke, kl, kp, kv = jax.random.split(jax.random.key(seed), 4)
+        self.params = {
+            "embed": _init_mlp(ke, (obs_dim, embed), scale_last=1.0),
+            "lstm": _init_lstm(kl, embed, lstm_size),
+            "pi": _init_mlp(kp, (lstm_size, act_dim)),
+            "vf": _init_mlp(kv, (lstm_size, 1), scale_last=1.0),
+        }
+        if not self.discrete:
+            self.params["log_std"] = jnp.zeros((act_dim,), jnp.float32)
+        self._step = jax.jit(self._step_impl)
+
+    def initial_state(self, n: int) -> tuple[np.ndarray, np.ndarray]:
+        return (np.zeros((n, self.hidden), np.float32),
+                np.zeros((n, self.hidden), np.float32))
+
+    # ---- traced pieces ----
+
+    def _embed(self, params, obs):
+        return jnp.tanh(_mlp(params["embed"], obs.astype(jnp.float32)))
+
+    def _heads(self, params, h):
+        logits = _mlp(params["pi"], h)
+        vf = _mlp(params["vf"], h)[..., 0]
+        return logits, vf
+
+    def _logp_entropy(self, params, logits, actions):
+        if self.discrete:
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, actions[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+            ent = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            return logp, ent
+        std = jnp.exp(params["log_std"])
+        d = (actions - logits) / std
+        logp = -0.5 * jnp.sum(
+            d * d + 2 * jnp.log(std) + jnp.log(2 * jnp.pi), axis=-1)
+        ent = jnp.sum(jnp.log(std) + 0.5 * jnp.log(2 * jnp.pi * jnp.e))
+        return logp, jnp.broadcast_to(ent, logp.shape)
+
+    def _step_impl(self, params, obs, h, c, key):
+        x = self._embed(params, obs)
+        h2, c2 = _lstm_step(params["lstm"], x, h, c)
+        logits, vf = self._heads(params, h2)
+        if self.discrete:
+            actions = jax.random.categorical(key, logits)
+        else:
+            actions = logits + jnp.exp(params["log_std"]) * \
+                jax.random.normal(key, logits.shape)
+        logp, _ = self._logp_entropy(params, logits, actions)
+        return actions, logp, vf, h2, c2
+
+    def sequence(self, params, obs_tm, ep_start, h0, c0):
+        """Unroll over [T, N, ...]: carry resets to zero wherever
+        ep_start[t] flags a new episode. → (logits [T,N,A], vf [T,N])."""
+        x = self._embed(params, obs_tm)                     # [T,N,E]
+
+        def scan_fn(carry, inp):
+            h, c = carry
+            xt, reset = inp
+            keep = (1.0 - reset)[:, None]
+            h, c = h * keep, c * keep
+            h, c = _lstm_step(params["lstm"], xt, h, c)
+            return (h, c), h
+
+        (_, _), hs = jax.lax.scan(scan_fn, (h0, c0), (x, ep_start))
+        return self._heads(params, hs)
+
+    # ---- host API ----
+
+    def compute_actions(self, obs, key, state):
+        h, c = state
+        a, lp, vf, h2, c2 = self._step(
+            self.params, jnp.asarray(obs), jnp.asarray(h), jnp.asarray(c),
+            key)
+        return (np.asarray(a), np.asarray(lp), np.asarray(vf),
+                (np.asarray(h2), np.asarray(c2)))
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.device_put(weights)
+
+
+class RecurrentPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.clip_param = 0.2
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_sgd_iter = 4
+        self.lambda_ = 0.95
+        self.grad_clip = 0.5
+        self.lstm_size = 64
+        self.embed_size = 64
+
+
+class RecurrentPPO(Algorithm):
+    """PPO over an LSTM policy: local sampling with state threading,
+    full-fragment BPTT epochs (sequence semantics make flat shuffling
+    wrong; the reference trains recurrent policies on time-major
+    fragments the same way)."""
+
+    def __init__(self, config: RecurrentPPOConfig):
+        if config.num_rollout_workers:
+            raise ValueError(
+                "RecurrentPPO samples locally (hidden-state threading is "
+                "not distributed yet); set num_rollout_workers=0 and use "
+                "num_envs_per_worker for vector parallelism")
+        # The base WorkerSet is a minimal stub (env introspection only).
+        self._num_envs = config.num_envs_per_worker
+        config = config.copy()
+        config.num_envs_per_worker = 1
+        super().__init__(config)
+
+    @classmethod
+    def get_default_config(cls) -> RecurrentPPOConfig:
+        return RecurrentPPOConfig()
+
+    def setup(self) -> None:
+        cfg: RecurrentPPOConfig = self.config
+        self.env = make_env(cfg.env, num_envs=self._num_envs,
+                            seed=cfg.env_seed)
+        self.policy = RecurrentPolicy(
+            self.env.observation_space, self.env.action_space,
+            embed=cfg.embed_size, lstm_size=cfg.lstm_size,
+            seed=cfg.env_seed)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip), optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self._key = jax.random.key(cfg.env_seed)
+        self.obs = self.env.reset()
+        self._h, self._c = self.policy.initial_state(self.env.num_envs)
+        self._next_starts = np.ones(self.env.num_envs, np.float32)
+        self._running = np.zeros(self.env.num_envs, np.float64)
+        self.episode_returns: list[float] = []
+        self._update = jax.jit(self._update_impl, donate_argnums=(0, 1))
+
+    # ---- sampling ----
+
+    def _sample_fragment(self) -> SampleBatch:
+        cfg = self.config
+        T, N = cfg.rollout_fragment_length, self.env.num_envs
+        cols = {
+            sb.OBS: np.zeros((T, N) + self.env.observation_space.shape,
+                             np.float32),
+            sb.ACTIONS: None,
+            sb.REWARDS: np.zeros((T, N), np.float32),
+            sb.DONES: np.zeros((T, N), bool),
+            sb.TRUNCS: np.zeros((T, N), bool),
+            sb.LOGP: np.zeros((T, N), np.float32),
+            sb.VF_PREDS: np.zeros((T, N), np.float32),
+            sb.BOOTSTRAP_VALUES: np.zeros((T, N), np.float32),
+            EP_START: np.zeros((T, N), np.float32),
+        }
+        h0, c0 = self._h.copy(), self._c.copy()
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            cols[sb.OBS][t] = self.obs
+            cols[EP_START][t] = self._next_starts
+            # Host mirrors the in-loss reset: zero the state rows that
+            # start a new episode BEFORE stepping them.
+            keep = (1.0 - self._next_starts)[:, None]
+            # New arrays: compute_actions returns read-only zero-copy
+            # views of device buffers.
+            self._h = self._h * keep
+            self._c = self._c * keep
+            a, lp, vf, (self._h, self._c) = self.policy.compute_actions(
+                self.obs, sub, (self._h, self._c))
+            if cols[sb.ACTIONS] is None:
+                cols[sb.ACTIONS] = np.zeros((T, N) + a.shape[1:], a.dtype)
+            next_obs, reward, done, trunc = self.env.step(a)
+            finished = np.logical_or(done, trunc)
+            if trunc.any():
+                # Time-limit handling (matches rollout_worker.py): value
+                # the PRE-reset terminal obs with the post-action hidden
+                # state; compute_gae bootstraps truncated steps through
+                # it instead of treating them as terminals.
+                self._key, sub2 = jax.random.split(self._key)
+                _a2, _lp2, boot_vf, _st2 = self.policy.compute_actions(
+                    self.env.final_obs, sub2, (self._h, self._c))
+                cols[sb.BOOTSTRAP_VALUES][t] = np.where(
+                    trunc, boot_vf, 0.0)
+            cols[sb.ACTIONS][t] = a
+            cols[sb.REWARDS][t] = reward
+            cols[sb.DONES][t] = done
+            cols[sb.TRUNCS][t] = trunc
+            cols[sb.LOGP][t] = lp
+            cols[sb.VF_PREDS][t] = vf
+            self._running += reward
+            for i in np.nonzero(finished)[0]:
+                self.episode_returns.append(float(self._running[i]))
+                self._running[i] = 0.0
+            self._next_starts = finished.astype(np.float32)
+            self.obs = next_obs
+            self._timesteps_total += N
+        batch = SampleBatch(cols)
+        batch[STATE_H], batch[STATE_C] = h0, c0
+        # Bootstrap value for the fragment tail (state already advanced).
+        self._key, sub = jax.random.split(self._key)
+        keep = (1.0 - self._next_starts)[:, None]
+        _a, _lp, last_vf, _st = self.policy.compute_actions(
+            self.obs, sub, (self._h * keep, self._c * keep))
+        batch["last_values"] = np.where(
+            self._next_starts > 0, 0.0, last_vf).astype(np.float32)
+        return batch
+
+    # ---- learning ----
+
+    def _update_impl(self, params, opt_state, batch):
+        cfg: RecurrentPPOConfig = self.config
+        pol = self.policy
+
+        def loss_fn(params):
+            logits, values = pol.sequence(
+                params, batch[sb.OBS], batch[EP_START],
+                batch[STATE_H], batch[STATE_C])
+            logp, entropy = pol._logp_entropy(
+                params, logits, batch[sb.ACTIONS])
+            ratio = jnp.exp(logp - batch[sb.LOGP])
+            adv = batch[sb.ADVANTAGES]
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip_param,
+                         1 + cfg.clip_param) * adv)
+            vf_loss = jnp.mean((values - batch[sb.VALUE_TARGETS]) ** 2)
+            return (-jnp.mean(surr) + cfg.vf_loss_coeff * vf_loss
+                    - cfg.entropy_coeff * jnp.mean(entropy))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def training_step(self) -> dict:
+        cfg: RecurrentPPOConfig = self.config
+        batch = self._sample_fragment()
+        batch = sb.compute_gae(batch, batch.pop("last_values"),
+                               gamma=cfg.gamma, lam=cfg.lambda_)
+        adv = batch[sb.ADVANTAGES]
+        batch[sb.ADVANTAGES] = (
+            (adv - adv.mean()) / max(1e-8, adv.std())).astype(np.float32)
+        dev = {k: jnp.asarray(v) for k, v in batch.items()}
+        loss = None
+        for _ in range(cfg.num_sgd_iter):
+            self.policy.params, self.opt_state, loss = self._update(
+                self.policy.params, self.opt_state, dev)
+        recent = self.episode_returns[-100:]
+        return {"total_loss": float(loss),
+                "episode_return_mean":
+                    float(np.mean(recent)) if recent else None}
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def stop(self) -> None:
+        super().stop()
+
+
+RecurrentPPOConfig.algo_class = RecurrentPPO
+
+__all__ = ["RecurrentPPO", "RecurrentPPOConfig", "RecurrentPolicy"]

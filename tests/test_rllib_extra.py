@@ -514,3 +514,73 @@ class TestDecisionTransformer:
         three = next(e for e in eps if len(e["rewards"]) == 3)
         np.testing.assert_allclose(three["rtg"], [6.0, 4.0, 2.0])
         assert list(three["actions"]) == [10, 11, 12]
+
+
+class TestRecurrentPPO:
+    """LSTM policies (ref: models/catalog.py use_lstm + recurrent_net.py):
+    hidden-state threading through sampling and a scan-unrolled BPTT loss
+    with episode-boundary carry resets."""
+
+    def test_lstm_solves_memory_task_where_feedforward_cannot(self):
+        from ray_tpu.rllib import PPOConfig, RecurrentPPOConfig
+
+        # Feedforward ceiling on MemoryCue is 0 (the cue is invisible
+        # after t=0; best a memoryless policy can do is guess).
+        ff = (PPOConfig().environment("MemoryCue-v0", seed=0)
+              .rollouts(num_envs_per_worker=16, rollout_fragment_length=64)
+              .training(num_sgd_iter=4, sgd_minibatch_size=256)).build()
+        ff_best = -1e9
+        for _ in range(10):
+            r = ff.train()
+            if r["episode_return_mean"] is not None:
+                ff_best = max(ff_best, r["episode_return_mean"])
+        ff.stop()
+        assert ff_best < 0.5, ff_best
+
+        rec = (RecurrentPPOConfig().environment("MemoryCue-v0", seed=0)
+               .rollouts(num_envs_per_worker=16,
+                         rollout_fragment_length=64)
+               .training(lr=3e-3, num_sgd_iter=4, entropy_coeff=0.01,
+                         lstm_size=32, embed_size=32)).build()
+        best = -1e9
+        for _ in range(30):
+            r = rec.train()
+            if r["episode_return_mean"] is not None:
+                best = max(best, r["episode_return_mean"])
+            if best > 0.8:
+                break
+        rec.stop()
+        assert best > 0.8, best
+
+    def test_sequence_resets_carry_at_episode_starts(self):
+        """With ep_start all-ones the scan must equal stateless per-step
+        outputs; with zeros the carry flows and outputs differ."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.env import Space
+        from ray_tpu.rllib.recurrent import RecurrentPolicy
+
+        pol = RecurrentPolicy(Space((3,), np.float32),
+                              Space((), np.int64, n=2),
+                              embed=8, lstm_size=8, seed=0)
+        T, N = 5, 2
+        obs = jnp.asarray(
+            np.random.default_rng(0).normal(size=(T, N, 3)), jnp.float32)
+        h0 = jnp.zeros((N, 8)); c0 = jnp.zeros((N, 8))
+        all_reset = jnp.ones((T, N), jnp.float32)
+        no_reset = jnp.zeros((T, N), jnp.float32)
+        lg_reset, _ = pol.sequence(pol.params, obs, all_reset, h0, c0)
+        lg_flow, _ = pol.sequence(pol.params, obs, no_reset, h0, c0)
+        # Per-step-reset path == stepping each obs from a zero state.
+        per_step = []
+        for t in range(T):
+            h, c = _ = (jnp.zeros((N, 8)), jnp.zeros((N, 8)))
+            from ray_tpu.rllib.recurrent import _lstm_step
+            x = pol._embed(pol.params, obs[t])
+            h2, c2 = _lstm_step(pol.params["lstm"], x, h, c)
+            per_step.append(pol._heads(pol.params, h2)[0])
+        np.testing.assert_allclose(np.asarray(lg_reset),
+                                   np.stack(per_step), rtol=1e-5)
+        assert not np.allclose(np.asarray(lg_reset)[1:],
+                               np.asarray(lg_flow)[1:])
