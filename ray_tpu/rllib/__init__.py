@@ -21,6 +21,7 @@ from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.ars import ARS, ARSConfig
 from ray_tpu.rllib.bandit import LinTS, LinUCB
+from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.dt import DT
 from ray_tpu.rllib.es import ES, ESConfig
@@ -65,7 +66,7 @@ __all__ = [
     "Connector", "ConnectorPipeline", "MeanStdFilter", "ClipActions",
     "BC", "MARWIL", "ES", "ESConfig", "ARS", "ARSConfig", "PG", "PGConfig",
     "DDPPO", "DDPPOConfig", "ApexDQN", "ApexDQNConfig",
-    "LinUCB", "LinTS", "DT",
+    "LinUCB", "LinTS", "DT", "CQL", "CQLConfig",
     "RecurrentPPO", "RecurrentPPOConfig", "RecurrentPolicy",
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",

@@ -106,26 +106,37 @@ class JsonReader:
 
 
 def collect_dataset(env_name: str, path: str, *, timesteps: int = 20_000,
-                    policy=None, epsilon: float = 0.3, seed: int = 0,
-                    num_envs: int = 8) -> str:
-    """Roll a behavior policy (or uniform-random when policy=None, mixed
-    with epsilon exploration otherwise) and log (obs, action, reward,
-    done, next_obs) transitions — the standard offline-RL dataset shape
-    (ref: offline/json_writer.py usage in rllib `output=` config)."""
+                    policy=None, behavior_fn=None, epsilon: float = 0.3,
+                    seed: int = 0, num_envs: int = 8) -> str:
+    """Roll a behavior policy and log (obs, action, reward, done, trunc,
+    next_obs) transitions — the standard offline-RL dataset shape (ref:
+    offline/json_writer.py usage in rllib `output=` config).
+
+    Behavior: `behavior_fn(obs) -> actions` if given (any action space);
+    else `policy` with epsilon-greedy exploration (discrete); else
+    uniform random over the action space."""
     import jax
 
     from ray_tpu.rllib.env import make_env
 
     env = make_env(env_name, num_envs=num_envs, seed=seed)
-    assert env.action_space.discrete, "collect_dataset: discrete actions"
+    discrete = env.action_space.discrete
     rng = np.random.default_rng(seed)
     writer = JsonWriter(path)
     obs = env.reset()
     steps = 0
     while steps < timesteps:
-        if policy is None:
-            actions = rng.integers(0, env.action_space.n, env.num_envs)
+        if behavior_fn is not None:
+            actions = np.asarray(behavior_fn(obs))
+        elif policy is None:
+            if discrete:
+                actions = rng.integers(0, env.action_space.n, env.num_envs)
+            else:
+                actions = rng.uniform(
+                    env.action_space.low, env.action_space.high,
+                    (env.num_envs,) + tuple(env.action_space.shape))
         else:
+            assert discrete, "policy-based collection is discrete-only"
             key = jax.random.key(rng.integers(2**31))
             greedy, _lp, _vf = policy.compute_actions(obs, key)
             explore = rng.random(env.num_envs) < epsilon
@@ -139,7 +150,8 @@ def collect_dataset(env_name: str, path: str, *, timesteps: int = 20_000,
             env.final_obs, next_obs)
         writer.write(SampleBatch({
             sb.OBS: obs.astype(np.float32),
-            sb.ACTIONS: actions.astype(np.int64),
+            sb.ACTIONS: (actions.astype(np.int64) if discrete
+                         else actions.astype(np.float32)),
             sb.REWARDS: reward.astype(np.float32),
             sb.DONES: done,
             sb.TRUNCS: trunc,

@@ -327,9 +327,6 @@ class RecurrentPPO(Algorithm):
     def set_weights(self, weights) -> None:
         self.policy.set_weights(weights)
 
-    def stop(self) -> None:
-        super().stop()
-
 
 RecurrentPPOConfig.algo_class = RecurrentPPO
 

@@ -102,25 +102,35 @@ class SAC(OffPolicyDriver, Algorithm):
 
     # ---- one fused update: Qs, policy, alpha ----
 
+    def _critic_td_loss(self, params, target_q, batch, key):
+        """Twin-Q TD loss against the entropy-corrected min-target — the
+        critic half of the SAC objective, shared with CQL's BC phase."""
+        cfg: SACConfig = self.config
+        a_next, logp_next = self._pi(params, batch[sb.NEXT_OBS], key)
+        alpha = jnp.exp(params["log_alpha"])
+        qt = jnp.minimum(
+            self._q(target_q["q1"], batch[sb.NEXT_OBS], a_next),
+            self._q(target_q["q2"], batch[sb.NEXT_OBS], a_next))
+        target = jax.lax.stop_gradient(
+            batch[sb.REWARDS] + cfg.gamma
+            * (1.0 - batch[sb.DONES].astype(jnp.float32))
+            * (qt - jax.lax.stop_gradient(alpha) * logp_next))
+        q1 = self._q(params["q1"], batch[sb.OBS], batch[sb.ACTIONS])
+        q2 = self._q(params["q2"], batch[sb.OBS], batch[sb.ACTIONS])
+        return jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+    def _q_penalty(self, params, batch, key):
+        """Subclass hook: extra critic regularizer added to the total
+        loss (CQL's conservative term, rllib/cql.py here). 0 for SAC."""
+        return 0.0
+
     def _update_impl(self, params, opt_state, key, target_q, batch):
         cfg: SACConfig = self.config
-        k1, k2 = jax.random.split(key)
+        k1, k2, k3 = jax.random.split(key, 3)
 
         def loss_fn(params):
             alpha = jnp.exp(params["log_alpha"])
-            # target: r + γ(1-d)(min target-Q(s', a') − α log π(a'|s'))
-            a_next, logp_next = self._pi(params, batch[sb.NEXT_OBS], k1)
-            qt = jnp.minimum(
-                self._q(target_q["q1"], batch[sb.NEXT_OBS], a_next),
-                self._q(target_q["q2"], batch[sb.NEXT_OBS], a_next))
-            target = batch[sb.REWARDS] + cfg.gamma * (
-                1.0 - batch[sb.DONES].astype(jnp.float32)
-            ) * (qt - jax.lax.stop_gradient(alpha) * logp_next)
-            target = jax.lax.stop_gradient(target)
-            q1 = self._q(params["q1"], batch[sb.OBS], batch[sb.ACTIONS])
-            q2 = self._q(params["q2"], batch[sb.OBS], batch[sb.ACTIONS])
-            q_loss = jnp.mean((q1 - target) ** 2) + jnp.mean(
-                (q2 - target) ** 2)
+            q_loss = self._critic_td_loss(params, target_q, batch, k1)
 
             a_new, logp_new = self._pi(params, batch[sb.OBS], k2)
             q_new = jnp.minimum(
@@ -134,7 +144,8 @@ class SAC(OffPolicyDriver, Algorithm):
             alpha_loss = -jnp.mean(
                 params["log_alpha"]
                 * jax.lax.stop_gradient(logp_new + self.target_entropy))
-            total = q_loss + pi_loss + alpha_loss
+            total = (q_loss + pi_loss + alpha_loss
+                     + self._q_penalty(params, batch, k3))
             return total, (q_loss, pi_loss, alpha)
 
         (total, (q_loss, pi_loss, alpha)), grads = jax.value_and_grad(
