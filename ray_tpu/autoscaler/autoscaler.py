@@ -20,6 +20,7 @@ import time
 from typing import Any
 
 from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
+from ray_tpu.core.config import Config
 
 logger = logging.getLogger(__name__)
 
@@ -94,7 +95,7 @@ class StandardAutoscaler:
         # for the same unmet demand (ref: resource_demand_scheduler pending
         # node accounting).
         self._booting: dict[str, tuple[str, float]] = {}  # id → (type, t0)
-        self.boot_timeout_s = 300.0
+        self.boot_timeout_s = Config.from_env().autoscaler_boot_timeout_s
 
     # ---- inputs ----
 

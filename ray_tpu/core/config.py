@@ -178,6 +178,11 @@ class Config:
     # GCS (re)connect + node re-registration deadline.
     gcs_register_timeout_s: float = 30.0
 
+    # --- autoscaler ---
+    # How long a launched node may take to register with the GCS before
+    # the reconciler writes it off and relaunches.
+    autoscaler_boot_timeout_s: float = 300.0
+
     # --- train gang rendezvous ---
     # jax.distributed.initialize connection window for a worker gang.
     train_rendezvous_timeout_s: float = 300.0
