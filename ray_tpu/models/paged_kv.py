@@ -1,0 +1,189 @@
+"""Block-paged KV cache for the serving engine.
+
+The dense cache (models/decode.py `init_kv_cache`) preallocates
+``[L, B, T_max]`` per slot — HBM capacity, not compute, caps the slot
+count (OPT-1.3B at 16 slots × 2048 OOM'd a 16 GB chip, ROUND4_NOTES
+item 1b). Paged KV decouples slot count from max_len: a shared pool of
+fixed-size pages ``[L, P+1, page_size, H, K]`` plus a per-slot page
+table ``[B, max_pages]`` of page ids. Slots consume pages as they grow,
+so pool capacity is sized to the *expected total live tokens*, not
+``B × T_max`` worst case (PAPERS.md "Ragged Paged Attention"; the
+reference's serving delegates KV management to torch models —
+`/root/reference/python/ray/serve/batching.py:1` is the capability
+being out-scaled here).
+
+XLA-first layout decisions:
+- Page 0 is a reserved null page. Table entries that aren't allocated
+  point at 0; writes land there harmlessly and reads of it are always
+  position-masked, so every shape stays static with no host branching.
+- Reads gather the slot's pages back into a contiguous
+  ``[B, T, H, K]`` timeline per layer (transient, inside the layer
+  scan) and run the *same* attention math as the dense path — the two
+  engines are exact-match by construction (tested).
+- Writes scatter at ``(table[b, pos // ps], pos % ps)``. Distinct live
+  slots never share a page, so scatter indices never collide on real
+  pages.
+
+Page allocation/free is host-side engine policy (ray_tpu.serve.llm):
+admission back-pressure, window-bounded lazy allocation, and
+preempt-by-recompute when the pool runs dry.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.gpt import GPTConfig, _layer_norm
+from ray_tpu.models.decode import _BLOCK_KEYS, _head, _mlp, _qkv, _rotary_pos
+
+
+def init_paged_kv(cfg: GPTConfig, n_pages: int, page_size: int):
+    """Shared page pool. Row 0 is the null page (never allocated)."""
+    shape = (cfg.n_layers, n_pages + 1, page_size, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
+    """Prefill N prompts, scattering their K/V into allocated pages.
+
+    tokens: [N, S_bucket]; pages: [N, ceil(S_bucket / page_size)] page ids
+    (unallocated tail entries = 0 → null page); lengths: [N].
+    → (last-token logits [N, V] fp32, updated pool). Attention is the
+    standard causal prompt self-attention (no pool reads needed).
+    """
+    N, S = tokens.shape
+    ps = pool["k"].shape[2]
+    n_pg = pages.shape[1]
+    S_pad = n_pg * ps
+    x = params["wte"].astype(cfg.dtype)[tokens]            # [N, S, D]
+    pos = jnp.broadcast_to(jnp.arange(S)[None, :], (N, S))
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    flat_pages = pages.reshape(-1)                         # [N * n_pg]
+
+    def body(x, inputs):
+        layer, k_pool_l, v_pool_l = inputs
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        q, k, v = _qkv(h, layer, cfg)
+        q = _rotary_pos(q, cfg.rotary_dim, pos)
+        k = _rotary_pos(k, cfg.rotary_dim, pos)
+        logits = jnp.einsum("bshk,bthk->bhst", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        causal = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        logits = jnp.where(causal[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhst,bthk->bshk", probs, v)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn,
+                           layer["wo"].astype(cfg.dtype))
+        x = _mlp(x, layer, cfg)
+
+        def paged(arr):                                    # [N,S,H,K] → pages
+            a = jnp.pad(arr, ((0, 0), (0, S_pad - S), (0, 0), (0, 0)))
+            return a.reshape(N * n_pg, ps, cfg.n_heads, cfg.head_dim)
+
+        k_pool_l = k_pool_l.at[flat_pages].set(paged(k.astype(cfg.dtype)))
+        v_pool_l = v_pool_l.at[flat_pages].set(paged(v.astype(cfg.dtype)))
+        return x, (k_pool_l, v_pool_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (stacked, pool["k"], pool["v"]))
+    logits = _head(params, cfg, x)                         # [N, S, V]
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return last, {"k": new_k, "v": new_v}
+
+
+def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
+                       tables):
+    """All B slots advance one token against the page pool.
+
+    tokens: [B]; positions: [B]; tables: [B, max_pages].
+    → (logits [B, V] fp32, updated pool). Math is identical to the dense
+    `_decode_once` — the gather reconstitutes each slot's contiguous
+    timeline [B, T, H, K] (T = max_pages × page_size) per layer.
+    """
+    B = tokens.shape[0]
+    ps = pool["k"].shape[2]
+    n_pg = tables.shape[1]
+    T = n_pg * ps
+    x = params["wte"].astype(cfg.dtype)[tokens][:, None, :]  # [B, 1, D]
+    pos = positions[:, None]
+    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    write_page = jnp.take_along_axis(
+        tables, (positions // ps)[:, None], axis=1)[:, 0]    # [B]
+    write_off = positions % ps                               # [B]
+
+    def body(x, inputs):
+        layer, k_pool_l, v_pool_l = inputs
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        q, k, v = _qkv(h, layer, cfg)
+        q = _rotary_pos(q, cfg.rotary_dim, pos)
+        k = _rotary_pos(k, cfg.rotary_dim, pos)
+        k_pool_l = k_pool_l.at[write_page, write_off].set(
+            k[:, 0].astype(cfg.dtype))
+        v_pool_l = v_pool_l.at[write_page, write_off].set(
+            v[:, 0].astype(cfg.dtype))
+        # Gather the slot's pages into a contiguous [B, T, H, K] view.
+        k_view = k_pool_l[tables].reshape(B, T, cfg.n_heads, cfg.head_dim)
+        v_view = v_pool_l[tables].reshape(B, T, cfg.n_heads, cfg.head_dim)
+        logits = jnp.einsum("bhk,bthk->bht", q[:, 0], k_view,
+                            preferred_element_type=jnp.float32) * scale
+        mask = jnp.arange(T)[None, :] <= positions[:, None]  # [B, T]
+        logits = jnp.where(mask[:, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bht,bthk->bhk", probs, v_view)
+        x = x + jnp.einsum("bhk,hkd->bd", attn,
+                           layer["wo"].astype(cfg.dtype))[:, None, :]
+        x = _mlp(x, layer, cfg)
+        return x, (k_pool_l, v_pool_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (stacked, pool["k"], pool["v"]))
+    logits = _head(params, cfg, x)[:, 0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+def decode_step_paged(cfg: GPTConfig, params, tokens, pool, positions,
+                      tables):
+    """One token for every slot against the paged pool.
+    → (logits [B, V] fp32, updated pool)."""
+    return _decode_once_paged(cfg, params, tokens, pool, positions, tables)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 6), donate_argnums=(3,))
+def decode_multi_paged(cfg: GPTConfig, params, tokens, pool, positions,
+                       tables, n_steps: int, temps, key):
+    """`n_steps` fused paged-decode steps with on-device sampling (the
+    paged twin of decode.decode_multi — the engine pre-allocates pages
+    covering positions + n_steps before dispatch, so tables are static
+    across the window). → (tokens_out [n_steps, B] int32, updated pool).
+    """
+
+    def step(carry, _):
+        toks, pos, pool, key = carry
+        logits, pool = _decode_once_paged(
+            cfg, params, toks, pool, pos, tables)
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = jax.random.categorical(
+            sub, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+        nxt = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+        return (nxt, pos + 1, pool, key), nxt
+
+    (_, _, pool, _), out = jax.lax.scan(
+        step, (tokens, positions, pool, key), None, length=n_steps)
+    return out, pool
+
+
+__all__ = [
+    "init_paged_kv", "prefill_batch_paged", "decode_step_paged",
+    "decode_multi_paged",
+]

@@ -133,8 +133,13 @@ class CQL(SAC):
         unif = jax.random.uniform(
             ku, (n, B, self.act_dim),
             minval=self.act_low, maxval=self.act_high)
-        log_vol = self.act_dim * jnp.log(
-            jnp.maximum(self.act_high - self.act_low, 1e-6))
+        # Scalar sum of per-dim log-widths. Broadcasting to [D] first makes
+        # this correct whether the env bounds are scalars or [D] vectors
+        # (a bare sum of a scalar width would drop the act_dim factor; a
+        # [D] vector must not be left unsummed against [n, B] weights).
+        width = jnp.broadcast_to(
+            jnp.asarray(self.act_high - self.act_low), (self.act_dim,))
+        log_vol = jnp.sum(jnp.log(jnp.maximum(width, 1e-6)))
         # Policy proposals at s and s' (reparameterized, env-scaled);
         # _pi's logp is in tanh space — correct to env space by -log|scale|.
         def pi_n(obs, k):
@@ -146,8 +151,9 @@ class CQL(SAC):
             # POLICY toward low-Q actions — exactly backwards.
             return (jax.lax.stop_gradient(acts),
                     jax.lax.stop_gradient(
-                        logps - self.act_dim * jnp.log(
-                            jnp.maximum(scale, 1e-6))))
+                        logps - jnp.sum(jnp.log(jnp.maximum(
+                            jnp.broadcast_to(jnp.asarray(scale),
+                                             (self.act_dim,)), 1e-6)))))
 
         a_pi, lp_pi = pi_n(batch[sb.OBS], kp1)            # [n, B, D], [n, B]
         a_pi2, lp_pi2 = pi_n(batch[sb.NEXT_OBS], kp2)

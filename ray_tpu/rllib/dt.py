@@ -78,8 +78,13 @@ class DT:
     def __init__(self, path: str, *, obs_dim: int, n_actions: int,
                  context: int = 20, d_model: int = 64, n_layers: int = 2,
                  n_heads: int = 4, lr: float = 1e-3, rtg_scale: float = 100.0,
-                 seed: int = 0):
+                 max_timestep: int | None = None, seed: int = 0):
         self.episodes = _episodes_from_log(path)
+        # Timestep-embedding table capacity: JAX's clamping gather would
+        # silently alias all timesteps past the table end to one row, so
+        # size it from the data (or an explicit bound) and assert at use.
+        longest = max(len(e["rewards"]) for e in self.episodes)
+        self.max_timestep = max(max_timestep or 0, longest + context, 4096)
         self.obs_dim = obs_dim
         self.n_actions = n_actions
         self.K = context
@@ -102,7 +107,7 @@ class DT:
             "emb_act": jax.random.normal(
                 ks[2], (n_actions + 1, d), jnp.float32) * 0.02,
             "emb_t": jax.random.normal(
-                ks[3], (4096, d), jnp.float32) * 0.02,
+                ks[3], (self.max_timestep, d), jnp.float32) * 0.02,
             "head": _init_linear(ks[4], d, n_actions, scale=0.01),
             "blocks": [],
         }
@@ -200,6 +205,10 @@ class DT:
                 act_in[i, K - n + 1: K] = ep["actions"][start:end - 1]
             ts[i, sl] = np.arange(start, end)
             mask[i, sl] = 1.0
+        if ts.max() >= self.max_timestep:
+            raise ValueError(
+                f"logged timestep {int(ts.max())} exceeds the embedding "
+                f"table ({self.max_timestep}); pass a larger max_timestep")
         return {"rtg": jnp.asarray(rtg), "obs": jnp.asarray(obs),
                 "act_in": jnp.asarray(act_in),
                 "target": jnp.asarray(target), "t": jnp.asarray(ts),
@@ -243,6 +252,10 @@ class DT:
                 obs_w[0, sl] = obs_hist[-n:]
                 if n > 1:
                     act_w[0, K - n + 1: K] = act_hist[-(n - 1):]
+                if t0 >= self.max_timestep:
+                    raise ValueError(
+                        f"eval timestep {t0} exceeds the embedding table "
+                        f"({self.max_timestep}); pass a larger max_timestep")
                 ts_w[0, sl] = np.arange(t0 + 1 - n, t0 + 1)
                 mask_w = np.zeros((1, K), np.float32)
                 mask_w[0, sl] = 1.0

@@ -258,6 +258,87 @@ class TestContinuousBatching:
         assert len(req.out_ids) < 100  # cut off by cache capacity
 
 
+class TestPagedKV:
+    """Block-paged KV cache (models/paged_kv.py): exact-match vs the dense
+    engine, pool back-pressure, and preempt-by-recompute under a pool too
+    small for the working set (VERDICT r4 next #2)."""
+
+    def _run(self, params, prompts, *, kv_mode, max_tokens=6, **kw):
+        eng = LLMEngine(CFG, params, n_slots=4, max_len=64,
+                        prefill_buckets=(16,), kv_mode=kv_mode, **kw)
+        reqs = [eng.submit(p, max_tokens=max_tokens) for p in prompts]
+        for _ in range(500):
+            if all(r.done.is_set() for r in reqs):
+                break
+            eng.step()
+        assert all(r.done.is_set() for r in reqs)
+        assert all(r.error is None for r in reqs)
+        return [r.out_ids for r in reqs], eng
+
+    def test_paged_matches_dense(self, params):
+        """Same prompts, greedy: the paged engine emits byte-identical
+        token streams to the dense engine (the gather view reconstitutes
+        the exact dense timeline)."""
+        prompts = [[5, 9, 2], [17, 3], [1, 2, 3, 4, 5, 6, 7], [11]]
+        dense, _ = self._run(params, prompts, kv_mode="dense")
+        paged, eng = self._run(params, prompts, kv_mode="paged",
+                               page_size=16)
+        assert paged == dense
+        m = eng.metrics()
+        # All pages returned to the pool after the requests retired.
+        assert m["kv_pages_free"] == m["kv_pages_total"]
+        assert m["preemptions"] == 0
+
+    def test_pool_backpressure_queues_admissions(self, params):
+        """A pool with fewer pages than slots×need still completes every
+        request — admission defers instead of failing."""
+        prompts = [[3 + i, 1, 4] for i in range(6)]
+        dense, _ = self._run(params, prompts, kv_mode="dense",
+                             max_tokens=4)
+        paged, eng = self._run(params, prompts, kv_mode="paged",
+                               page_size=4, n_pages=2, max_tokens=4)
+        assert paged == dense
+        assert all(len(o) == 4 for o in paged)
+        assert eng.metrics()["kv_pages_free"] == 2
+
+    def test_preemption_recompute_is_exact(self, params):
+        """Pool sized so concurrent slots MUST run dry mid-generation:
+        victims are evicted by recompute (context = prompt + generated)
+        and still produce the exact greedy continuation."""
+        prompts = [[5, 9, 2], [17, 3], [2, 4, 6], [8, 1, 0]]
+        dense, _ = self._run(params, prompts, kv_mode="dense",
+                             max_tokens=10)
+        # Each request grows to 13 tokens → 4 pages of 4; four slots need
+        # 16 pages but the pool has 7 → eviction pressure mid-flight.
+        paged, eng = self._run(params, prompts, kv_mode="paged",
+                               page_size=4, n_pages=7, max_tokens=10)
+        assert paged == dense
+        m = eng.metrics()
+        assert m["preemptions"] > 0
+        assert m["kv_pages_free"] == m["kv_pages_total"]
+
+    def test_infeasible_prompt_rejected_at_submit(self, params):
+        """A prompt the pool can never cover is rejected loudly instead of
+        requeueing forever."""
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=64,
+                        prefill_buckets=(16,), kv_mode="paged",
+                        page_size=4, n_pages=2)
+        with pytest.raises(ValueError, match="KV pages"):
+            eng.submit(list(range(12)), max_tokens=4)
+
+    def test_engine_side_metrics_present(self, params):
+        """The engine reports device-side throughput split from the
+        client path: decode tok/s, prefill tok/s, occupancy (VERDICT r4
+        next #3)."""
+        _, eng = self._run(params, [[5, 9, 2], [7, 7]], kv_mode="paged",
+                           page_size=16, max_tokens=8)
+        m = eng.metrics()
+        assert m["engine_decode_tok_s"] > 0
+        assert m["engine_prefill_tok_s"] > 0
+        assert 0 < m["slot_occupancy"] <= 1
+        assert m["decode_windows"] > 0
+
+
 class TestServeIntegration:
     def test_llm_deployment_parallel_requests(self):
         import ray_tpu
