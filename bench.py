@@ -216,8 +216,19 @@ def main() -> None:
             )
             B = int(os.environ.get("BENCH_BATCH", str(8 * n_dev)))
         # BENCH_OPT=adafactor for tiers whose fp32 adam moments don't fit
-        # one chip (see train/memory_audit.py + tests/test_sharding_audit).
-        if os.environ.get("BENCH_OPT", "adamw") == "adafactor":
+        # one chip; BENCH_OPT=adafactor_sr additionally keeps the MASTER
+        # WEIGHTS in bf16 with stochastic-rounding updates (halves param
+        # + grad residency — the 2.7B-tier enabler, train/low_precision.py;
+        # see train/memory_audit.py + tests/test_sharding_audit).
+        bench_opt = os.environ.get("BENCH_OPT", "adamw")
+        stochastic_round = False
+        if bench_opt == "adafactor_sr":
+            import dataclasses
+
+            optimizer = optax.adafactor(3e-4)
+            stochastic_round = True
+            cfg = dataclasses.replace(cfg, param_dtype=jnp.bfloat16)
+        elif bench_opt == "adafactor":
             optimizer = optax.adafactor(3e-4)
         else:
             # Adam's first moment in bf16 (default; BENCH_MU=fp32 to
@@ -232,7 +243,8 @@ def main() -> None:
             optimizer = optax.adamw(3e-4, weight_decay=0.1,
                                     mu_dtype=mu_dtype)
         params, opt_state, step = spmd.build_training(
-            cfg, mesh, optimizer, jax.random.key(0)
+            cfg, mesh, optimizer, jax.random.key(0),
+            stochastic_round=stochastic_round,
         )
 
         rng = np.random.default_rng(0)

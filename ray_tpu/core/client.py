@@ -1851,6 +1851,15 @@ class CoreClient:
         return self._run(self.gcs.call("kv_get", {"ns": ns, "key": key}),
                          timeout=60)
 
+    def kv_keys(self, ns: str, prefix: bytes = b"") -> list:
+        return self._run(self.gcs.call("kv_keys",
+                                       {"ns": ns, "prefix": prefix}),
+                         timeout=60)
+
+    def kv_del(self, ns: str, key: bytes) -> bool:
+        return self._run(self.gcs.call("kv_del", {"ns": ns, "key": key}),
+                         timeout=60)["deleted"]
+
     # -------------------------------------------------- placement groups
 
     def create_placement_group(self, pg_id: bytes, bundles: list,

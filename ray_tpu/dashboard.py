@@ -95,6 +95,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # the bytes).
                 return self._json(
                     state.list_cluster_events(limit=1000, tail=True))
+            if self.path in ("/api/serve/applications",
+                             "/api/serve/applications/"):
+                # REST mirror of `serve status` (ref: the reference's
+                # serve REST API, python/ray/serve/schema.py:1).
+                from ray_tpu.serve.schema import app_statuses
+
+                return self._json(app_statuses())
             if self.path in ("/api/jobs", "/api/jobs/"):
                 return self._json(ray_tpu.get(
                     self.server.jobs.list.remote(), timeout=30))
@@ -128,6 +135,38 @@ class _Handler(BaseHTTPRequestHandler):
                 stopped = ray_tpu.get(
                     self.server.jobs.stop.remote(m.group(1)), timeout=30)
                 return self._json({"stopped": stopped})
+            self._json({"error": "unknown endpoint"}, 404)
+        except Exception as e:
+            self._json({"error": repr(e)}, 500)
+
+    def do_PUT(self):
+        try:
+            if self.path in ("/api/serve/applications",
+                             "/api/serve/applications/"):
+                # Declarative deploy: body is the ServeConfig dict. Replies
+                # after submission (non-blocking) — poll GET for readiness.
+                from ray_tpu.serve.schema import ServeConfig, deploy_config
+
+                cfg = ServeConfig.from_dict(self._body())
+                out = deploy_config(cfg, blocking=False)
+                return self._json({"deployed": out})
+            self._json({"error": "unknown endpoint"}, 404)
+        except ValueError as e:
+            self._json({"error": str(e)}, 400)
+        except Exception as e:
+            self._json({"error": repr(e)}, 500)
+
+    def do_DELETE(self):
+        try:
+            m = re.fullmatch(r"/api/serve/applications/([^/]+)", self.path)
+            if m:
+                from ray_tpu.serve.schema import delete_app
+
+                try:
+                    deleted = delete_app(m.group(1))
+                except KeyError:
+                    return self._json({"error": "not found"}, 404)
+                return self._json({"deleted": deleted})
             self._json({"error": "unknown endpoint"}, 404)
         except Exception as e:
             self._json({"error": repr(e)}, 500)

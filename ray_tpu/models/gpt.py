@@ -61,6 +61,18 @@ class GPTConfig:
         return cls(d_model=1024, n_layers=24, n_heads=16, d_ff=4096, **kw)
 
     @classmethod
+    def gpt2_2_7b(cls, **kw) -> "GPTConfig":
+        """GPT-Neo-2.7B-class decoder (2.77 B params). The largest tier a
+        single 16 GB chip can train — with bf16 master weights +
+        stochastic rounding + adafactor (train/low_precision.py); fp32
+        masters at this size need fsdp≥2."""
+        kw.setdefault("remat", True)
+        return cls(
+            d_model=2560, n_layers=32, n_heads=32, d_ff=10240,
+            rotary_dim=64, tie_embeddings=False, **kw
+        )
+
+    @classmethod
     def gptj_6b(cls, **kw) -> "GPTConfig":
         kw.setdefault("remat", True)
         return cls(
@@ -95,8 +107,8 @@ class GPTConfig:
         kw.setdefault("tie_embeddings", False)
         return cls.tiny(**kw)
 
-    _REGISTRY = ("gpt2_124m", "gpt2_350m", "gptj_6b", "opt_1_3b", "tiny",
-                 "tiny_untied")
+    _REGISTRY = ("gpt2_124m", "gpt2_350m", "gpt2_2_7b", "gptj_6b",
+                 "opt_1_3b", "tiny", "tiny_untied")
 
     @classmethod
     def by_name(cls, name: str, **kw) -> "GPTConfig":

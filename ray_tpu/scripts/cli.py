@@ -235,6 +235,63 @@ def cmd_job(args) -> None:
         print(JobSubmissionClient().stop_job(args.job_id))
 
 
+def cmd_serve(args) -> None:
+    """`serve deploy/status/delete/build` — the declarative ops surface
+    (ref: /root/reference/python/ray/serve/scripts.py:1). deploy applies a
+    YAML app config and reconciles removed deployments; build emits a
+    config skeleton for an import path."""
+    import os
+
+    sys.path.insert(0, os.getcwd())   # resolve user import_paths like
+    # `serve run` does in the reference
+    if args.serve_cmd == "deploy":
+        from ray_tpu.serve.schema import ServeConfig, deploy_config
+
+        cfg = ServeConfig.from_yaml_file(args.config)
+        _attach(args)
+        out = deploy_config(cfg, blocking=not args.no_wait,
+                            timeout=args.timeout)
+        print(json.dumps({"deployed": out}, indent=2))
+    elif args.serve_cmd == "status":
+        from ray_tpu.serve.schema import app_statuses
+
+        _attach(args)
+        print(json.dumps(app_statuses(), indent=2, default=str))
+    elif args.serve_cmd == "delete":
+        _attach(args)
+        if args.app:
+            from ray_tpu.serve.schema import delete_app
+
+            print(json.dumps({"deleted": delete_app(args.name)}))
+        else:
+            from ray_tpu import serve
+
+            serve.delete(args.name)
+            print(json.dumps({"deleted": [args.name]}))
+    elif args.serve_cmd == "build":
+        from ray_tpu.serve.schema import _deployment_names, _import_target
+        from ray_tpu.serve.api import Deployment
+        import yaml
+
+        target = _import_target(args.import_path)
+        if callable(target) and not isinstance(target, Deployment):
+            target = target()
+        skeleton = {"applications": [{
+            "name": args.name or target.name,
+            "import_path": args.import_path,
+            "route_prefix": target.route_prefix,
+            "deployments": [
+                {"name": n, "num_replicas": 1}
+                for n in sorted(set(_deployment_names(target)))],
+        }]}
+        text = yaml.safe_dump(skeleton, sort_keys=False)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+        else:
+            print(text, end="")
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -299,6 +356,29 @@ def main(argv: list[str] | None = None) -> None:
     j.add_argument("job_id")
     j.add_argument("--address")
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("serve", help="serve app config deploy/ops")
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    s = ssub.add_parser("deploy", help="apply a YAML app config")
+    s.add_argument("config")
+    s.add_argument("--address")
+    s.add_argument("--no-wait", action="store_true",
+                   help="don't block until replicas are ready")
+    s.add_argument("--timeout", type=float, default=180.0)
+    s = ssub.add_parser("status", help="application + deployment status")
+    s.add_argument("--address")
+    s = ssub.add_parser("delete", help="delete a deployment or --app")
+    s.add_argument("name")
+    s.add_argument("--app", action="store_true",
+                   help="treat NAME as an application (delete its whole "
+                        "manifest)")
+    s.add_argument("--address")
+    s = ssub.add_parser("build",
+                        help="emit a config skeleton for an import path")
+    s.add_argument("import_path")
+    s.add_argument("--name")
+    s.add_argument("-o", "--output")
+    sp.set_defaults(fn=cmd_serve)
 
     args = p.parse_args(argv)
     args.fn(args)

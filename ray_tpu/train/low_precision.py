@@ -1,0 +1,76 @@
+"""Low-precision training enablers: bf16 master weights with stochastic
+rounding.
+
+The 16 GB v5e HBM budget caps full-precision single-chip training around
+the 1.3B tier (fp32 masters + grads alone are ~4× params —
+`train/memory_audit.py`). Keeping the master weights IN bf16 halves both
+the param and grad residency (2 + 2 bytes/param vs 4 + 4), which is what
+moves the single-chip ceiling to the 2.7B tier.
+
+Plain bf16 masters stagnate: with 8 mantissa bits, any update smaller
+than ~2^-8 of the weight rounds to zero and learning stops as updates
+shrink. The fix is *stochastic rounding* — round up with probability
+proportional to the truncated fraction, so the EXPECTED weight change
+equals the fp32 update even when every individual update is sub-ulp.
+This is the standard recipe for bf16-weight training on TPUs (the
+reference's big-model path instead shards fp32 state across GPUs via
+ZeRO/FSDP, e.g. `/root/reference/python/ray/train/torch/config.py:1` —
+a TPU single-chip budget needs the precision lever, not just the
+sharding lever).
+
+Implementation: bit-level SR on the fp32 pattern. For positive floats
+the IEEE-754 bit pattern is monotone in value, so adding a uniform
+16-bit integer to the low (truncated) mantissa bits and then masking
+them off rounds the magnitude up with exactly the right probability
+(carries propagate into the exponent correctly). Negative floats have a
+reversed-ordered pattern, so the same trick rounds their *magnitude*
+stochastically — unbiased in value either way.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stochastic_round_bf16(x: jax.Array, key: jax.Array) -> jax.Array:
+    """Round fp32 → bf16 stochastically: E[result] == x (up to bf16 range).
+
+    x: fp32 array; key: PRNG key. Deterministic given (x, key).
+    """
+    x = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    bits = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(jnp.bfloat16)
+
+
+def sr_apply_updates(params, updates, count: jax.Array,
+                     base_key: int = 0x5121, impl: str = "rbg"):
+    """`optax.apply_updates` twin for bf16 masters: add the fp32 update to
+    the fp32 view of each bf16 param and stochastically round back down.
+
+    `count` (a traced uint32 step counter) plus the leaf index derive the
+    per-leaf PRNG stream, so the step function needs no threaded key and
+    replay/resume stays deterministic. Non-bf16 leaves fall back to a
+    plain cast-free add.
+
+    impl: PRNG for the rounding noise. "rbg" hits the TPU hardware RNG —
+    threefry for the full param tree costs real step time at the
+    billions-of-params scale where SR is used (only statistical quality
+    needed here, not cross-backend stability).
+    """
+    leaves, treedef = jax.tree.flatten(params)
+    upd = treedef.flatten_up_to(updates)
+    root = jax.random.fold_in(jax.random.key(base_key, impl=impl), count)
+    out = []
+    for i, (p, u) in enumerate(zip(leaves, upd)):
+        x = p.astype(jnp.float32) + u.astype(jnp.float32)
+        if p.dtype == jnp.bfloat16:
+            out.append(stochastic_round_bf16(x, jax.random.fold_in(root, i)))
+        else:
+            out.append(x.astype(p.dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+__all__ = ["stochastic_round_bf16", "sr_apply_updates"]

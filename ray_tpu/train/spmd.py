@@ -67,21 +67,42 @@ def make_train_step(
     *,
     batch_spec: PartitionSpec = PartitionSpec(("dp", "fsdp"), "sp"),
     donate: bool = True,
+    stochastic_round: bool = False,
 ):
     """Build the jitted SPMD train step.
 
     loss_fn(params, *batch) -> scalar. `batch` is passed to the step as one
     pytree (tuple of arrays), every leaf sharded by `batch_spec`
     ([batch, seq] by default — dp+fsdp on batch, sp on sequence).
+
+    stochastic_round=True is the bf16-master-weights path
+    (train/low_precision.py): grads are upcast to fp32 for the optimizer
+    and applied with stochastic rounding; opt_state gains a uint32 step
+    counter that drives the rounding PRNG, so the caller must init it as
+    `(optimizer.init(params), jnp.uint32(0))` (build_training does).
     """
     batch_sharding = NamedSharding(mesh, batch_spec)
     repl = NamedSharding(mesh, PartitionSpec())
 
-    def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+    if stochastic_round:
+        from ray_tpu.train.low_precision import sr_apply_updates
+
+        def step(params, opt_state, batch):
+            inner, count = opt_state
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            grads = jax.tree.map(
+                lambda g: g.astype(jax.numpy.float32), grads)
+            updates, inner = optimizer.update(grads, inner, params)
+            params = sr_apply_updates(params, updates, count)
+            return params, (inner, count + 1), loss
+
+        opt_shardings = (opt_shardings, repl)
+    else:
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
 
     return jax.jit(
         step,
@@ -98,12 +119,15 @@ def build_training(
     rng: jax.Array,
     rules=DEFAULT_LOGICAL_RULES,
     model=None,
+    stochastic_round: bool = False,
 ):
     """End-to-end: model params + opt state sharded on `mesh`, jitted step.
 
     `model` is a module exposing logical_axes/init_params/loss_fn (defaults
     to models.gpt; models.llama works identically — the PARAM_SPECS table
     convention makes trainers model-agnostic).
+    `stochastic_round=True` enables the bf16-master-weights path (set
+    cfg.param_dtype=bfloat16 with it — see train/low_precision.py).
     Returns (params, opt_state, step_fn) where
     step_fn(params, opt_state, (tokens, targets)) -> (params, opt_state, loss).
     """
@@ -116,11 +140,16 @@ def build_training(
     )
     o_shard = opt_state_shardings(optimizer, params, p_shard)
     opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
+    if stochastic_round:
+        import jax.numpy as jnp
+
+        opt_state = (opt_state, jnp.uint32(0))
 
     def loss(params, tokens, targets):
         return model.loss_fn(params, tokens, targets, cfg, mesh)
 
-    step_fn = make_train_step(loss, optimizer, mesh, p_shard, o_shard)
+    step_fn = make_train_step(loss, optimizer, mesh, p_shard, o_shard,
+                              stochastic_round=stochastic_round)
     return params, opt_state, step_fn
 
 

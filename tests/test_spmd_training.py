@@ -138,3 +138,58 @@ def test_causality(cpu_devices):
     out2 = gpt.forward(params, jnp.asarray(toks2), cfg)
     np.testing.assert_allclose(out1[0, :20], out2[0, :20], rtol=1e-5, atol=1e-6)
     assert not np.allclose(out1[0, 20], out2[0, 20])
+
+
+class TestLowPrecision:
+    """bf16 master weights + stochastic rounding (train/low_precision.py)
+    — the single-chip 2.7B-tier memory enabler (VERDICT r4 next #1)."""
+
+    def test_stochastic_round_unbiased_and_exact(self, cpu_devices):
+        from ray_tpu.train.low_precision import stochastic_round_bf16
+
+        # Values exactly representable in bf16 never move.
+        y = jnp.asarray(np.float32([1.0, 0.5, -2.0, 0.0]))
+        r = stochastic_round_bf16(y, jax.random.key(0))
+        assert np.all(np.asarray(r, np.float32) == np.asarray(y))
+        # Sub-ulp values round UP with the right probability: the mean
+        # over keys converges to x instead of truncating to a fixed
+        # neighbor (plain bf16 cast would be deterministically biased).
+        x = jnp.asarray(np.float32([1.0 + 1 / 512, 3e-4, -2.5e-5]))
+        acc = np.zeros(3, np.float64)
+        n = 400
+        for i in range(n):
+            acc += np.asarray(stochastic_round_bf16(x, jax.random.key(i)),
+                              np.float64)
+        rel = np.abs(acc / n - np.asarray(x, np.float64)) / np.abs(
+            np.asarray(x, np.float64))
+        assert rel.max() < 5e-3, rel
+
+    def test_bf16_sr_training_tracks_fp32(self, cpu_devices):
+        """The SR step learns: loss drops, and the trajectory stays close
+        to the fp32-master reference run on identical data."""
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=-1, sp=1, tp=1))
+        rng = np.random.default_rng(0)
+        B, S = 8, 64
+
+        def run(param_dtype, sr):
+            cfg = gpt.GPTConfig.tiny(param_dtype=param_dtype)
+            opt = optax.adafactor(1e-2)
+            params, st, step = spmd.build_training(
+                cfg, mesh, opt, jax.random.key(0), stochastic_round=sr)
+            toks = jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+            tg = jnp.roll(toks, -1, 1)
+            first = last = None
+            for _ in range(40):
+                params, st, loss = step(params, st, (toks, tg))
+                last = float(loss)
+                first = first if first is not None else last
+            assert all(
+                p.dtype == (jnp.bfloat16 if sr else jnp.float32)
+                for p in jax.tree.leaves(params))
+            return first, last
+
+        f_first, f_last = run(jnp.float32, False)
+        s_first, s_last = run(jnp.bfloat16, True)
+        assert s_last < s_first - 0.5          # it learns
+        assert abs(s_last - f_last) < 0.1      # and tracks fp32 closely
