@@ -213,6 +213,8 @@ def main() -> None:
                 remat=os.environ.get("BENCH_REMAT", "1") == "1",
                 attn_impl=os.environ.get("BENCH_ATTN", "flash"),
                 loss_chunk=chunk,
+                attn_block_q=int(os.environ.get("BENCH_BLOCK_Q", "512")),
+                attn_block_kv=int(os.environ.get("BENCH_BLOCK_KV", "512")),
             )
             B = int(os.environ.get("BENCH_BATCH", str(8 * n_dev)))
         # BENCH_OPT=adafactor for tiers whose fp32 adam moments don't fit
@@ -229,7 +231,13 @@ def main() -> None:
             stochastic_round = True
             cfg = dataclasses.replace(cfg, param_dtype=jnp.bfloat16)
         elif bench_opt == "adafactor":
-            optimizer = optax.adafactor(3e-4)
+            # BENCH_AF_NOSCALE=1 drops multiply_by_parameter_scale (its
+            # param-RMS reduce + fp32 broadcast temps showed up as the
+            # largest optimizer-phase allocations in the B=12 OOM dump).
+            optimizer = optax.adafactor(
+                3e-4,
+                multiply_by_parameter_scale=not os.environ.get(
+                    "BENCH_AF_NOSCALE"))
         else:
             # Adam's first moment in bf16 (default; BENCH_MU=fp32 to
             # ablate) halves the mu read+write HBM traffic per step —

@@ -41,6 +41,9 @@ class GPTConfig:
     tie_embeddings: bool = True
     remat: bool = False              # jax.checkpoint each block (for big models)
     attn_impl: str = "xla"           # "xla" | "flash" (pallas) | "ring" (sp-sharded)
+    # Pallas flash-attention tile sizes (perf knob; see BENCH.md ablation).
+    attn_block_q: int = 512
+    attn_block_kv: int = 512
     # Cross-entropy head chunking: compute logits/loss over sequence chunks of
     # this many tokens (bounds the fp32 [B, chunk, V] materialization instead
     # of [B, S, V] — at B=32, S=1024, V=50k the unchunked fp32 logits alone
@@ -208,7 +211,9 @@ def _attention(q, k, v, cfg: GPTConfig, *, causal_offset: int = 0, mesh=None):
     if cfg.attn_impl == "flash":
         from ray_tpu.ops.attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               block_q=cfg.attn_block_q,
+                               block_kv=cfg.attn_block_kv)
     if cfg.attn_impl == "ring":
         from ray_tpu.parallel.ring import ring_attention_sharded
 

@@ -8,6 +8,8 @@ are numpy vector envs on host actors.
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.a2c import A2C, A2CConfig
+from ray_tpu.rllib.callbacks import DefaultCallbacks
+from ray_tpu.rllib.evaluation import EvalRunner, EvalWorkerSet
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import (
     CartPole,
@@ -71,6 +73,7 @@ __all__ = [
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",
+    "DefaultCallbacks", "EvalRunner", "EvalWorkerSet",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
     "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",
     "Pendulum", "MemoryCue", "make_env", "register_env",

@@ -39,6 +39,39 @@ class AlgorithmConfig:
         # "mean_std" normalizes obs with fleet-synced running moments.
         self.observation_filter: str | None = None
         self.clip_actions = False
+        # Evaluation (ref: algorithm.py step() eval interleave +
+        # evaluation WorkerSet): every `evaluation_interval` train
+        # iterations, run `evaluation_duration` greedy episodes on a
+        # SEPARATE worker set; results land under result["evaluation"].
+        self.evaluation_interval: int | None = None
+        self.evaluation_num_workers = 0
+        self.evaluation_duration = 5
+        # With remote eval workers, launch episode futures BEFORE the
+        # learner's training_step (evaluating the previous iteration's
+        # weights) so evaluation never pauses sampling/learning.
+        self.evaluation_parallel_to_training = False
+        # Lifecycle callbacks (ref: rllib/algorithms/callbacks.py).
+        self.callbacks_class: type | None = None
+
+    def evaluation(self, *, evaluation_interval: int | None = None,
+                   evaluation_num_workers: int | None = None,
+                   evaluation_duration: int | None = None,
+                   evaluation_parallel_to_training: bool | None = None,
+                   ) -> "AlgorithmConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_workers is not None:
+            self.evaluation_num_workers = evaluation_num_workers
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        if evaluation_parallel_to_training is not None:
+            self.evaluation_parallel_to_training = (
+                evaluation_parallel_to_training)
+        return self
+
+    def callbacks(self, callbacks_class: type) -> "AlgorithmConfig":
+        self.callbacks_class = callbacks_class
+        return self
 
     def environment(self, env, *, seed: int = 0) -> "AlgorithmConfig":
         self.env = env
@@ -82,8 +115,11 @@ class Algorithm:
     """Base: owns the WorkerSet; subclasses implement training_step()."""
 
     def __init__(self, config: AlgorithmConfig):
+        from ray_tpu.rllib.callbacks import DefaultCallbacks
+
         self.config = config
         self.iteration = 0
+        self.callbacks = (config.callbacks_class or DefaultCallbacks)()
         self.workers = WorkerSet(
             config.env,
             num_workers=config.num_rollout_workers,
@@ -94,9 +130,12 @@ class Algorithm:
             seed=config.env_seed,
             observation_filter=config.observation_filter,
             clip_actions=config.clip_actions,
+            callbacks_class=config.callbacks_class,
         )
         self._timesteps_total = 0
+        self._eval_set = None
         self.setup()
+        self.callbacks.on_algorithm_init(algorithm=self)
 
     # subclass hooks -------------------------------------------------------
 
@@ -110,6 +149,15 @@ class Algorithm:
 
     def train(self) -> dict:
         t0 = time.perf_counter()
+        cfg = self.config
+        eval_due = bool(cfg.evaluation_interval) and (
+            (self.iteration + 1) % cfg.evaluation_interval == 0)
+        eval_futures = None
+        if eval_due and cfg.evaluation_parallel_to_training:
+            # Futures launch on remote eval runners now (previous
+            # iteration's weights) and are gathered after training_step —
+            # evaluation overlaps learning instead of pausing it.
+            eval_futures = self._launch_evaluation()
         info = self.training_step()
         self.iteration += 1
         # Fold per-sampler obs-filter deltas into the fleet state once
@@ -125,7 +173,67 @@ class Algorithm:
             "time_this_iter_s": time.perf_counter() - t0,
             **info,
         }
+        if eval_due:
+            result["evaluation"] = self._finish_evaluation(eval_futures)
+        self.callbacks.on_train_result(algorithm=self, result=result)
         return result
+
+    # ---- evaluation (separate greedy WorkerSet; rllib/evaluation.py) ----
+
+    def _make_eval_actor(self):
+        """Picklable greedy actor for the eval runners. Default: the
+        shared Policy net with the training-time obs filter + action
+        clipping; non-Policy learners (DQN family, R2D2) override."""
+        from ray_tpu.rllib.evaluation import PolicyGreedyActor
+
+        w = self.workers.local
+        clip = None
+        if self.config.clip_actions and not w.env.action_space.discrete:
+            clip = (float(np.min(w.env.action_space.low)),
+                    float(np.max(w.env.action_space.high)))
+        return PolicyGreedyActor(
+            w.policy,
+            observation_filter=self.config.observation_filter,
+            filter_state=w.get_filter_state(),
+            clip=clip)
+
+    def _eval_workers(self):
+        from ray_tpu.rllib.evaluation import EvalWorkerSet
+
+        if self._eval_set is None:
+            cfg = self.config
+            self._eval_set = EvalWorkerSet(
+                cfg.env, num_workers=cfg.evaluation_num_workers,
+                num_envs_per_worker=cfg.num_envs_per_worker,
+                seed=cfg.env_seed)
+        return self._eval_set
+
+    def _launch_evaluation(self):
+        return self._eval_workers().launch(
+            self._make_eval_actor(), self.config.evaluation_duration)
+
+    def _finish_evaluation(self, futures) -> dict:
+        from ray_tpu.rllib.evaluation import summarize
+
+        ws = self._eval_workers()
+        n = self.config.evaluation_duration
+        if not futures and ws.remote_runners:
+            # Non-parallel mode still fans episodes out to the remote
+            # runners — they exist to be used.
+            futures = ws.launch(self._make_eval_actor(), n)
+        # Actor built lazily: the parallel path's futures already carry
+        # their own copy; device_get-ing the weights again would waste a
+        # full host transfer per round.
+        actor = None if futures else self._make_eval_actor()
+        raw = ws.collect(futures or [], actor, n)
+        em = summarize(raw)
+        self.callbacks.on_evaluate_end(algorithm=self,
+                                       evaluation_metrics=em)
+        return em
+
+    def evaluate(self) -> dict:
+        """On-demand evaluation round (same machinery train() uses)."""
+        return self._finish_evaluation(None)
 
     def get_weights(self):
         raise NotImplementedError
@@ -134,8 +242,10 @@ class Algorithm:
         raise NotImplementedError
 
     def save_checkpoint(self) -> dict:
-        return {"weights": self.get_weights(), "iteration": self.iteration,
+        ckpt = {"weights": self.get_weights(), "iteration": self.iteration,
                 "timesteps_total": self._timesteps_total}
+        self.callbacks.on_checkpoint(algorithm=self, checkpoint=ckpt)
+        return ckpt
 
     def load_checkpoint(self, ckpt: dict) -> None:
         self.set_weights(ckpt["weights"])
@@ -144,6 +254,8 @@ class Algorithm:
 
     def stop(self) -> None:
         self.workers.stop()
+        if self._eval_set is not None:
+            self._eval_set.stop()
 
     # Tune trainable contract ---------------------------------------------
 

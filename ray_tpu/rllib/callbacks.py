@@ -1,0 +1,47 @@
+"""Lifecycle callbacks for RLlib algorithms.
+
+Parity: `/root/reference/rllib/algorithms/callbacks.py:1` —
+`DefaultCallbacks` with overridable hooks invoked by the algorithm
+driver and by rollout workers (sampler-side hooks run in the worker
+process, so a remote worker's callback state is worker-local; aggregate
+through `on_train_result` on the driver).
+
+Usage:
+    class MyCallbacks(DefaultCallbacks):
+        def on_episode_end(self, *, worker, episode_return,
+                           episode_length, **kw):
+            ...
+    cfg = PPOConfig().callbacks(MyCallbacks)
+"""
+
+from __future__ import annotations
+
+
+class DefaultCallbacks:
+    """Override any subset; every hook is a no-op by default. Hooks take
+    keyword-only args and a **kwargs tail so subclasses survive new
+    fields being added."""
+
+    def on_algorithm_init(self, *, algorithm, **kwargs) -> None:
+        """Driver-side: once, at the end of Algorithm.__init__."""
+
+    def on_episode_end(self, *, worker, episode_return: float,
+                       episode_length: int, **kwargs) -> None:
+        """Sampler-side: each time an episode finishes during sample()."""
+
+    def on_sample_end(self, *, worker, samples, **kwargs) -> None:
+        """Sampler-side: after each fragment is collected."""
+
+    def on_train_result(self, *, algorithm, result: dict, **kwargs) -> None:
+        """Driver-side: after every train() iteration (result is mutable —
+        callbacks may annotate it)."""
+
+    def on_evaluate_end(self, *, algorithm, evaluation_metrics: dict,
+                        **kwargs) -> None:
+        """Driver-side: after each evaluation round."""
+
+    def on_checkpoint(self, *, algorithm, checkpoint: dict, **kwargs) -> None:
+        """Driver-side: after save_checkpoint() builds its dict."""
+
+
+__all__ = ["DefaultCallbacks"]

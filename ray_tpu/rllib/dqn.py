@@ -288,6 +288,17 @@ class DQN(Algorithm):
                 "loss": None if loss is None else float(loss),
                 "buffer_size": len(self.buffer)}
 
+    def _make_eval_actor(self):
+        # The learner is a raw Q-net, not the shared Policy — evaluate
+        # greedily via argmax-Q (rllib/evaluation.py QGreedyActor).
+        from ray_tpu.rllib.evaluation import QGreedyActor
+
+        cfg: DQNConfig = self.config
+        return QGreedyActor(
+            jax.device_get(self.params), n_actions=self.n_actions,
+            atoms=self.atoms, dueling=cfg.dueling,
+            z=getattr(self, "_z", None))
+
     def get_weights(self):
         return jax.device_get({"params": self.params,
                                "target": self.target_params})

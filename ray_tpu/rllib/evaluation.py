@@ -1,0 +1,206 @@
+"""Evaluation workers: greedy rollouts on a separate WorkerSet.
+
+Parity: `/root/reference/rllib/algorithms/algorithm.py:711` (`step()`
+interleaving evaluation with training on a dedicated evaluation
+WorkerSet sized by `evaluation_num_workers`) and
+`rllib/evaluation/worker_set.py`. Design differences, TPU-first:
+
+- Eval runners are *generic env drivers*: they receive a picklable
+  ACTOR OBJECT (obs → actions) instead of sharing the training policy
+  class, so any learner family — shared-Policy PPO or a raw Q-network
+  DQN — evaluates through the same machinery by providing an actor
+  factory (`Algorithm._make_eval_actor`).
+- With `evaluation_parallel_to_training`, episode futures launch on the
+  remote runners BEFORE the learner's training_step and are collected
+  after — evaluation rides the actor plane while the chip trains, so
+  sampling/learning never pause (the reference's
+  `evaluation_parallel_to_training` thread-pool equivalent).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+
+
+class PolicyGreedyActor:
+    """Picklable greedy actor over the shared Policy net (policy.py).
+
+    Stores weights + architecture + the TRAINING-TIME preprocessing
+    (observation-filter state, action clipping) — evaluation must see
+    exactly the pipeline the policy was trained on, or a mean_std-
+    normalized agent scores near-random on raw observations. Rebuilds
+    everything lazily in the process that runs it."""
+
+    def __init__(self, policy, *, observation_filter: str | None = None,
+                 filter_state=None, clip: tuple[float, float] | None = None):
+        self.weights = policy.get_weights()
+        self.obs_space = policy.obs_space
+        self.act_space = policy.action_space
+        self.hiddens = policy.hiddens
+        self.conv = policy.conv
+        self.observation_filter = observation_filter
+        self.filter_state = filter_state
+        self.clip = clip
+        self._policy = None
+        self._filter = None
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_policy"] = None
+        d["_filter"] = None
+        return d
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        if self._policy is None:
+            from ray_tpu.rllib.connectors import build_obs_pipeline
+            from ray_tpu.rllib.policy import Policy
+
+            self._policy = Policy(self.obs_space, self.act_space,
+                                  hiddens=self.hiddens, conv=self.conv)
+            self._policy.set_weights(self.weights)
+            self._filter = build_obs_pipeline(self.observation_filter,
+                                              self.obs_space.shape)
+            if self._filter is not None and self.filter_state is not None:
+                self._filter.set_state(self.filter_state)
+        if self._filter is not None:
+            obs = self._filter(obs)     # apply only — eval never update()s
+        actions = self._policy.compute_greedy_actions(obs)
+        if self.clip is not None:
+            actions = np.clip(actions, self.clip[0], self.clip[1])
+        return actions
+
+
+class QGreedyActor:
+    """Picklable argmax-Q actor for the DQN family (dqn.py heads)."""
+
+    def __init__(self, weights, *, n_actions: int, atoms: int = 1,
+                 dueling: bool = False, z=None):
+        self.weights = weights
+        self.n_actions = n_actions
+        self.atoms = atoms
+        self.dueling = dueling
+        self.z = None if z is None else np.asarray(z)
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.dqn import q_values
+
+        flat = np.asarray(obs, np.float32).reshape(obs.shape[0], -1)
+        q = q_values(self.weights, jnp.asarray(flat),
+                     dueling=self.dueling, atoms=self.atoms,
+                     n_actions=self.n_actions,
+                     z=None if self.z is None else jnp.asarray(self.z))
+        return np.asarray(jnp.argmax(q, axis=-1))
+
+
+class EvalRunner:
+    """Runs full greedy episodes with a provided actor. Stateless between
+    calls except the env (reset at each run)."""
+
+    def __init__(self, env, *, num_envs: int = 1, seed: int = 0,
+                 jax_platform: str | None = None,
+                 max_env_steps_per_episode: int = 10_000):
+        if jax_platform is not None:
+            import jax
+
+            jax.config.update("jax_platforms", jax_platform)
+        self.env = make_env(env, num_envs=num_envs, seed=seed)
+        self.max_steps = max_env_steps_per_episode
+
+    def run_episodes(self, actor, n_episodes: int) -> dict:
+        env = self.env
+        obs = env.reset()
+        N = env.num_envs
+        running = np.zeros(N, np.float32)
+        lengths = np.zeros(N, np.int64)
+        ep_returns: list[float] = []
+        ep_lengths: list[int] = []
+        # Hard step budget so a never-terminating policy can't hang the
+        # evaluation round.
+        budget = self.max_steps * max(1, (n_episodes + N - 1) // N)
+        for _ in range(budget):
+            if len(ep_returns) >= n_episodes:
+                break
+            actions = actor(obs)
+            obs, reward, done, trunc = env.step(actions)
+            running += reward
+            lengths += 1
+            finished = np.logical_or(done, trunc)
+            if finished.any() and hasattr(actor, "on_episode_boundary"):
+                # Stateful (recurrent) actors zero their carry for the
+                # lanes that just reset.
+                actor.on_episode_boundary(finished)
+            for i in np.nonzero(finished)[0]:
+                ep_returns.append(float(running[i]))
+                ep_lengths.append(int(lengths[i]))
+                running[i] = 0.0
+                lengths[i] = 0
+        return {"episode_returns": ep_returns[:n_episodes],
+                "episode_lengths": ep_lengths[:n_episodes]}
+
+
+class EvalWorkerSet:
+    """A local runner plus `num_workers` remote runner actors."""
+
+    def __init__(self, env, *, num_workers: int = 0, num_envs_per_worker: int = 1,
+                 seed: int = 0):
+        # Decorrelate eval streams from training streams.
+        self.local = EvalRunner(env, num_envs=num_envs_per_worker,
+                                seed=seed + 10_000)
+        self.remote_runners = []
+        if num_workers > 0:
+            actor_cls = ray_tpu.remote(EvalRunner)
+            self.remote_runners = [
+                actor_cls.remote(env, num_envs=num_envs_per_worker,
+                                 seed=seed + 10_000 + 97 * (i + 1),
+                                 jax_platform="cpu")
+                for i in range(num_workers)
+            ]
+
+    def launch(self, actor, n_episodes: int) -> list:
+        """Dispatch episode futures to the remote runners (round-robin
+        split). → list of object refs (empty if no remote runners)."""
+        if not self.remote_runners:
+            return []
+        k = len(self.remote_runners)
+        per = [n_episodes // k + (1 if i < n_episodes % k else 0)
+               for i in range(k)]
+        return [r.run_episodes.remote(actor, n)
+                for r, n in zip(self.remote_runners, per) if n > 0]
+
+    def collect(self, futures: list, actor, n_episodes: int) -> dict:
+        """Gather launched futures — or run locally when there are none."""
+        if not futures:
+            return self.local.run_episodes(actor, n_episodes)
+        outs = ray_tpu.get(futures, timeout=600)
+        return {
+            "episode_returns": [r for o in outs
+                                for r in o["episode_returns"]],
+            "episode_lengths": [l for o in outs
+                                for l in o["episode_lengths"]],
+        }
+
+    def stop(self) -> None:
+        for r in self.remote_runners:
+            ray_tpu.kill(r)
+
+
+def summarize(raw: dict) -> dict:
+    rets = raw["episode_returns"]
+    out = {"episodes_this_eval": len(rets)}
+    if rets:
+        out.update(
+            episode_return_mean=float(np.mean(rets)),
+            episode_return_min=float(np.min(rets)),
+            episode_return_max=float(np.max(rets)),
+            episode_len_mean=float(np.mean(raw["episode_lengths"])),
+        )
+    return out
+
+
+__all__ = ["EvalRunner", "EvalWorkerSet", "PolicyGreedyActor",
+           "QGreedyActor", "summarize"]

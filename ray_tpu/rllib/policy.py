@@ -56,6 +56,7 @@ class Policy:
         self.action_space = action_space
         self.discrete = action_space.discrete
         self.conv = conv
+        self.hiddens = tuple(hiddens)
         act_dim = action_space.n if self.discrete else int(
             np.prod(action_space.shape))
         key = jax.random.key(seed)
@@ -80,6 +81,7 @@ class Policy:
         if not self.discrete:
             self.params["log_std"] = jnp.zeros((act_dim,), jnp.float32)
         self._sample = jax.jit(self._sample_impl)
+        self._greedy = jax.jit(self._greedy_impl)
 
     # ---- features ----
 
@@ -141,12 +143,22 @@ class Policy:
             logp = self._logp(params, obs, actions)
         return actions, logp, vf
 
+    def _greedy_impl(self, params, obs):
+        mean_or_logits, _ = self._dist(params, obs)
+        if self.discrete:
+            return jnp.argmax(mean_or_logits, axis=-1)
+        return mean_or_logits    # gaussian mode = mean
+
     # ---- public API ----
 
     def compute_actions(self, obs: np.ndarray, key) -> tuple:
         """→ (actions, logp, vf_preds) as numpy."""
         a, lp, vf = self._sample(self.params, jnp.asarray(obs), key)
         return np.asarray(a), np.asarray(lp), np.asarray(vf)
+
+    def compute_greedy_actions(self, obs: np.ndarray) -> np.ndarray:
+        """Deterministic actions (argmax / gaussian mean) — evaluation."""
+        return np.asarray(self._greedy(self.params, jnp.asarray(obs)))
 
     def get_weights(self):
         return jax.device_get(self.params)

@@ -31,7 +31,8 @@ class RolloutWorker:
                  observation_filter: str | None = None,
                  clip_actions: bool = False,
                  jax_platform: str | None = None,
-                 env_seed: int | None = None):
+                 env_seed: int | None = None,
+                 callbacks_class: type | None = None):
         # Remote samplers run their small policy MLP on host CPU: per-step
         # inference on tiny batches would be dominated by TPU dispatch
         # latency, and the TPU belongs to the learner. Must happen before
@@ -60,6 +61,12 @@ class RolloutWorker:
         self.obs = self.env.reset()
         self.episode_returns: list[float] = []
         self._running_return = np.zeros(self.env.num_envs, np.float32)
+        self._running_len = np.zeros(self.env.num_envs, np.int64)
+        # Sampler-side lifecycle hooks (rllib/callbacks.py) — one instance
+        # per worker process, like the reference's per-worker callbacks.
+        from ray_tpu.rllib.callbacks import DefaultCallbacks
+
+        self.callbacks = (callbacks_class or DefaultCallbacks)()
 
     def set_weights(self, weights) -> None:
         self.policy.set_weights(weights)
@@ -118,10 +125,16 @@ class RolloutWorker:
                 _, _, vf_fin = self.policy.compute_actions(fin, sub)
                 cols[sb.BOOTSTRAP_VALUES][t] = np.where(trunc, vf_fin, 0.0)
             self._running_return += reward
+            self._running_len += 1
             finished = np.logical_or(done, trunc)
             for i in np.nonzero(finished)[0]:
                 self.episode_returns.append(float(self._running_return[i]))
+                self.callbacks.on_episode_end(
+                    worker=self,
+                    episode_return=float(self._running_return[i]),
+                    episode_length=int(self._running_len[i]))
                 self._running_return[i] = 0.0
+                self._running_len[i] = 0
         # Bootstrap values for the state after the fragment.
         self.key, sub = jax.random.split(self.key)
         last_in = (self.obs_filter(self.obs)
@@ -133,6 +146,7 @@ class RolloutWorker:
         # CURRENT params on the learner — ship the obs (as the policy
         # would see it) too.
         batch["last_obs"] = np.asarray(last_in).copy()
+        self.callbacks.on_sample_end(worker=self, samples=batch)
         return batch
 
     def get_filter_state(self):
@@ -165,11 +179,13 @@ class WorkerSet:
                  rollout_fragment_length: int = 64, hiddens=(64, 64),
                  conv: str | None = None, seed: int = 0,
                  observation_filter: str | None = None,
-                 clip_actions: bool = False):
+                 clip_actions: bool = False,
+                 callbacks_class: type | None = None):
         self.local = RolloutWorker(
             env, num_envs=num_envs_per_worker, seed=seed, hiddens=hiddens,
             conv=conv, rollout_fragment_length=rollout_fragment_length,
             observation_filter=observation_filter, clip_actions=clip_actions,
+            callbacks_class=callbacks_class,
         )
         self.remote_workers = []
         self._master_filter = None   # fleet-wide MeanStdFilter state
@@ -183,6 +199,7 @@ class WorkerSet:
                     observation_filter=observation_filter,
                     clip_actions=clip_actions,
                     jax_platform="cpu",
+                    callbacks_class=callbacks_class,
                 )
                 for i in range(num_workers)
             ]

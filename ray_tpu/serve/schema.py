@@ -241,6 +241,24 @@ def deploy_config(cfg: ServeConfig, *, blocking: bool = True,
         kv.kv_put(_APPS_NS, app.name.encode(),
                   json.dumps(declared).encode())
         result[app.name] = declared
+    # The config file is the FULL declared state (reference serve-deploy
+    # v2 semantics): applications previously deployed from config but
+    # absent from this file are torn down — except deployments the new
+    # config re-declares under a different app, which it now owns.
+    try:
+        known = [k.decode() if isinstance(k, bytes) else k
+                 for k in kv.kv_keys(_APPS_NS)]
+    except Exception:
+        known = []
+    for stale_app in sorted(set(known) - {a.name for a in cfg.applications}):
+        raw = kv.kv_get(_APPS_NS, stale_app.encode())
+        for dep_name in sorted(set(json.loads(raw) if raw else [])
+                               - all_declared):
+            try:
+                serve.delete(dep_name)
+            except Exception:
+                pass
+        kv.kv_del(_APPS_NS, stale_app.encode())
     return result
 
 

@@ -38,19 +38,28 @@ def sharded_init(
     return jax.jit(init_fn, out_shardings=shardings)(rng), shardings
 
 
-def opt_state_shardings(optimizer, params, params_shardings):
+def opt_state_shardings(optimizer, params, params_shardings, init_fn=None):
     """Shard optimizer state like the params it mirrors (ZeRO: the m/v moments
-    inherit the param sharding; scalars replicate)."""
-    shapes = jax.eval_shape(optimizer.init, params)
+    inherit the param sharding; scalars replicate). `init_fn` overrides
+    `optimizer.init` when the state is built from a transformed view of
+    the params (the bf16-master path inits from an fp32 view)."""
+    shapes = jax.eval_shape(init_fn or optimizer.init, params)
     flat_params, _ = jax.tree.flatten(params)
     spec_by_shape = {}
+    shape_only = {}
     flat_shard, _ = jax.tree.flatten(params_shardings)
     for p, s in zip(flat_params, flat_shard):
         spec_by_shape.setdefault((p.shape, p.dtype), s)
+        shape_only.setdefault(p.shape, s)
     mesh = jax.tree.leaves(params_shardings)[0].mesh
 
     def pick(leaf):
+        # Exact (shape, dtype) match first; shape-only second — fp32
+        # moments of bf16 params must still shard like the param, not
+        # silently replicate.
         s = spec_by_shape.get((leaf.shape, leaf.dtype))
+        if s is None:
+            s = shape_only.get(leaf.shape)
         if s is not None:
             return s
         return NamedSharding(mesh, PartitionSpec())
@@ -138,11 +147,22 @@ def build_training(
     params, p_shard = sharded_init(
         partial(model.init_params, cfg), logical, mesh, rng, rules
     )
-    o_shard = opt_state_shardings(optimizer, params, p_shard)
-    opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
-    if stochastic_round:
-        import jax.numpy as jnp
+    import jax.numpy as jnp
 
+    if stochastic_round:
+        # Init the moments from an fp32 VIEW of the (bf16) params: the
+        # step updates them with fp32 grads, so fp32-from-step-0 keeps
+        # the opt_state aval stable — a bf16 init would force a second
+        # full XLA compile on the first real step.
+        def init_fn(p):
+            return optimizer.init(
+                jax.tree.map(lambda x: x.astype(jnp.float32), p))
+    else:
+        init_fn = optimizer.init
+    o_shard = opt_state_shardings(optimizer, params, p_shard,
+                                  init_fn=init_fn)
+    opt_state = jax.jit(init_fn, out_shardings=o_shard)(params)
+    if stochastic_round:
         opt_state = (opt_state, jnp.uint32(0))
 
     def loss(params, tokens, targets):

@@ -1,0 +1,132 @@
+"""R2D2: recurrent off-policy replay with stored state + burn-in
+(VERDICT r4 missing #5 / next #7; ref:
+/root/reference/rllib/algorithms/r2d2/r2d2.py:1).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.r2d2 import (
+    R2D2Config,
+    R2D2Sampler,
+    init_rq_params,
+    rq_sequence,
+    rq_step,
+    value_rescale,
+    value_rescale_inv,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestPieces:
+    def test_value_rescale_roundtrip(self):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.float32([-50, -1.7, -1e-3, 0, 1e-3, 2.5, 80]))
+        back = value_rescale_inv(value_rescale(x))
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   rtol=5e-4, atol=1e-5)
+
+    def test_sequence_matches_stepwise_unroll(self):
+        """rq_sequence with mid-sequence episode resets equals stepping
+        rq_step with manual carry zeroing — the learner's unroll is the
+        sampler's reality."""
+        import jax
+        import jax.numpy as jnp
+
+        params = init_rq_params(jax.random.key(0), 3, 2, embed=8, lstm=8)
+        T, N = 6, 2
+        rng = np.random.default_rng(0)
+        obs = jnp.asarray(rng.normal(size=(T, N, 3)).astype(np.float32))
+        starts = np.zeros((T, N), np.float32)
+        starts[0, :] = 1.0
+        starts[3, 1] = 1.0          # lane 1 starts a new episode at t=3
+        h = jnp.zeros((N, 8)); c = jnp.zeros((N, 8))
+        q_seq, _ = rq_sequence(params, obs, jnp.asarray(starts), h, c)
+        hs, cs = np.zeros((N, 8), np.float32), np.zeros((N, 8), np.float32)
+        for t in range(T):
+            keep = (1.0 - starts[t])[:, None]
+            hs, cs = hs * keep, cs * keep
+            q, hs, cs = rq_step(params, obs[t], jnp.asarray(hs),
+                                jnp.asarray(cs))
+            hs, cs = np.asarray(hs), np.asarray(cs)
+            np.testing.assert_allclose(np.asarray(q_seq[t]), np.asarray(q),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_sampler_emits_stored_state_sequences(self):
+        import jax
+
+        s = R2D2Sampler("MemoryCue-v0", num_envs=3, seed=0, n_actions=2,
+                        epsilon=0.5, seq_len=10, stride=10,
+                        embed=8, lstm=8)
+        s.set_weights(jax.device_get(
+            init_rq_params(jax.random.key(0), 2, 2, embed=8, lstm=8)))
+        batch = s.sample()
+        assert batch["obs"].shape == (3, 10, 2)
+        assert batch["actions"].shape == (3, 10)
+        assert batch["h0"].shape == (3, 8)
+        # Every row's first step is flagged by ep_start bookkeeping
+        # somewhere in the sequence (episodes are 8 steps).
+        assert batch["ep_start"].sum() > 0
+        # Second emit advances by stride (ring rolls, no stall).
+        b2 = s.sample()
+        assert not np.array_equal(batch["obs"], b2["obs"])
+
+
+class TestR2D2Learning:
+    def test_smoke_updates_and_priorities(self, cluster):
+        cfg = (R2D2Config()
+               .environment("MemoryCue-v0", seed=0)
+               .rollouts(num_rollout_workers=1, num_envs_per_worker=4)
+               .training(learning_starts=8, sgd_rounds_per_step=2,
+                         updates_per_fragment=2))
+        algo = cfg.build()
+        res = None
+        for _ in range(6):
+            res = algo.train()
+        assert res["updates_total"] > 0
+        assert np.isfinite(res["loss"])
+        assert res["buffer_sequences"] > 8
+        algo.stop()
+
+    @pytest.mark.slow
+    def test_solves_memorycue_where_feedforward_cannot(self, cluster):
+        """The VERDICT's acceptance bar: from REPLAYED off-policy
+        sequences, the stored-state + burn-in recurrent learner recalls
+        the t=0 cue at t=7; a feedforward Ape-X on the same env is
+        structurally capped at 0 expected terminal reward."""
+        cfg = (R2D2Config()
+               .environment("MemoryCue-v0", seed=0)
+               .rollouts(num_rollout_workers=2, num_envs_per_worker=4))
+        algo = cfg.build()
+        score = -1.0
+        for _ in range(40):
+            algo.train()
+            score = algo.evaluate_greedy(episodes=10)
+            if score >= 0.9:
+                break
+        algo.stop()
+        assert score >= 0.9, f"R2D2 failed MemoryCue: greedy {score}"
+
+        from ray_tpu.rllib import ApexDQNConfig
+
+        ff = (ApexDQNConfig()
+              .environment("MemoryCue-v0", seed=0)
+              .rollouts(num_rollout_workers=2, num_envs_per_worker=4)
+              .training(learning_starts=128)
+              .evaluation(evaluation_duration=20))
+        ff_algo = ff.build()
+        for _ in range(15):
+            ff_algo.train()
+        ff_score = ff_algo.evaluate()["episode_return_mean"]
+        ff_algo.stop()
+        assert ff_score <= 0.3, (
+            f"feedforward should be memory-capped, got {ff_score}")

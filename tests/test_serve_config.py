@@ -158,6 +158,10 @@ class TestDeployFromConfig:
             "args": {"tag": "from_args"},
         }]})
         deploy_config(cfg)
+        # Full-declared-state semantics: the previous config's app
+        # (textapp) is absent from this file → torn down; but EchoCfg is
+        # re-declared here under builtapp, so it survives the handover.
+        assert "textapp" not in app_statuses()["applications"]
         h = serve.get_deployment_handle("EchoCfg")
         res = ray_tpu.get(h.remote({"v": 1}), timeout=60)
         assert res["tag"] == "from_args"
