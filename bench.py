@@ -207,15 +207,20 @@ def main() -> None:
             model_name = os.environ.get("BENCH_MODEL", "gpt2_124m")
             S = int(os.environ.get("BENCH_SEQ", "1024"))
             chunk = int(os.environ.get("BENCH_CHUNK", "0")) or None
-            cfg = gpt.GPTConfig.by_name(
-                model_name,
+            cfg_kw = dict(
                 max_seq=S,
                 remat=os.environ.get("BENCH_REMAT", "1") == "1",
                 attn_impl=os.environ.get("BENCH_ATTN", "flash"),
                 loss_chunk=chunk,
-                attn_block_q=int(os.environ.get("BENCH_BLOCK_Q", "512")),
-                attn_block_kv=int(os.environ.get("BENCH_BLOCK_KV", "512")),
             )
+            # Attention tiles: env overrides win; otherwise the model
+            # registry's per-tier defaults apply (1024 globally, 512 for
+            # 2.7B whose 1024-tile backward scratch OOMs one chip).
+            if os.environ.get("BENCH_BLOCK_Q"):
+                cfg_kw["attn_block_q"] = int(os.environ["BENCH_BLOCK_Q"])
+            if os.environ.get("BENCH_BLOCK_KV"):
+                cfg_kw["attn_block_kv"] = int(os.environ["BENCH_BLOCK_KV"])
+            cfg = gpt.GPTConfig.by_name(model_name, **cfg_kw)
             B = int(os.environ.get("BENCH_BATCH", str(8 * n_dev)))
         # BENCH_OPT=adafactor for tiers whose fp32 adam moments don't fit
         # one chip; BENCH_OPT=adafactor_sr additionally keeps the MASTER

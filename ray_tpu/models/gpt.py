@@ -41,9 +41,12 @@ class GPTConfig:
     tie_embeddings: bool = True
     remat: bool = False              # jax.checkpoint each block (for big models)
     attn_impl: str = "xla"           # "xla" | "flash" (pallas) | "ring" (sp-sharded)
-    # Pallas flash-attention tile sizes (perf knob; see BENCH.md ablation).
-    attn_block_q: int = 512
-    attn_block_kv: int = 512
+    # Pallas flash-attention tile sizes. 1024 measured best across the
+    # whole size curve on v5e (BENCH.md round-5 ablation: +8.6% tok/s at
+    # 124M, +2.5pp MFU at 1.3B vs 512) — at S<=1024 the kernel clamps to
+    # one tile per (batch, head), minimizing blocking overhead.
+    attn_block_q: int = 1024
+    attn_block_kv: int = 1024
     # Cross-entropy head chunking: compute logits/loss over sequence chunks of
     # this many tokens (bounds the fp32 [B, chunk, V] materialization instead
     # of [B, S, V] — at B=32, S=1024, V=50k the unchunked fp32 logits alone
@@ -70,6 +73,10 @@ class GPTConfig:
         stochastic rounding + adafactor (train/low_precision.py); fp32
         masters at this size need fsdp≥2."""
         kw.setdefault("remat", True)
+        # 512 attention tiles: the 1024-tile backward's scratch tips this
+        # tier over a 16 GB chip (measured OOM; 512 runs at MFU 0.359).
+        kw.setdefault("attn_block_q", 512)
+        kw.setdefault("attn_block_kv", 512)
         return cls(
             d_model=2560, n_layers=32, n_heads=32, d_ff=10240,
             rotary_dim=64, tie_embeddings=False, **kw

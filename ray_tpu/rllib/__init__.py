@@ -8,8 +8,11 @@ are numpy vector envs on host actors.
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.a2c import A2C, A2CConfig
+from ray_tpu.rllib.alpha_zero import AlphaZero, AlphaZeroConfig
 from ray_tpu.rllib.callbacks import DefaultCallbacks
 from ray_tpu.rllib.evaluation import EvalRunner, EvalWorkerSet
+from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TwoStepCoop
+from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import (
     CartPole,
@@ -73,6 +76,8 @@ __all__ = [
     "vtrace", "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentPPO",
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",
+    "AlphaZero", "AlphaZeroConfig", "QMIX", "QMIXConfig", "TwoStepCoop",
+    "R2D2", "R2D2Config",
     "DefaultCallbacks", "EvalRunner", "EvalWorkerSet",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
     "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",

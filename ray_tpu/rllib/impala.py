@@ -78,6 +78,11 @@ class IMPALAConfig(AlgorithmConfig):
         self.vtrace_clip_pg_rho_threshold = 1.0
         # Updates applied per train() iteration (each consumes one fragment).
         self.num_updates_per_iter = 8
+        # Gradient passes over EACH consumed fragment (>1 = sample reuse;
+        # V-trace's rho clipping — and APPO's surrogate clip — absorb the
+        # growing off-policyness of later passes. This is the
+        # sample-efficiency lever PPO gets from its epoch loop).
+        self.num_sgd_passes = 1
         # Push fresh weights to a sampler every N of ITS fragments (1 = on
         # every relaunch — the reference's default broadcast cadence).
         self.broadcast_interval = 1
@@ -185,10 +190,11 @@ class IMPALA(Algorithm):
 
             jb = {k: jnp.asarray(v) for k, v in batch.items()
                   if k != "last_values"}
-            (self.policy.params, self.opt_state, loss,
-             info) = self._learn(self.policy.params, self.opt_state, jb)
-            losses.append(float(loss))
-            infos.append(info)
+            for _pass in range(max(1, cfg.num_sgd_passes)):
+                (self.policy.params, self.opt_state, loss,
+                 info) = self._learn(self.policy.params, self.opt_state, jb)
+                losses.append(float(loss))
+                infos.append(info)
             T, N = batch[sb.REWARDS].shape
             self._timesteps_total += T * N
         if not infos:

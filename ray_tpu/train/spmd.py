@@ -41,8 +41,10 @@ def sharded_init(
 def opt_state_shardings(optimizer, params, params_shardings, init_fn=None):
     """Shard optimizer state like the params it mirrors (ZeRO: the m/v moments
     inherit the param sharding; scalars replicate). `init_fn` overrides
-    `optimizer.init` when the state is built from a transformed view of
-    the params (the bf16-master path inits from an fp32 view)."""
+    `optimizer.init` for callers whose state is built from a transformed
+    view of the params. NOTE: the bf16-master (SR) path deliberately uses
+    the PLAIN init — see the regression note in build_training; an fp32
+    view adds un-donatable first-step argument bytes that OOM big tiers."""
     shapes = jax.eval_shape(init_fn or optimizer.init, params)
     flat_params, _ = jax.tree.flatten(params)
     spec_by_shape = {}
@@ -149,20 +151,16 @@ def build_training(
     )
     import jax.numpy as jnp
 
+    o_shard = opt_state_shardings(optimizer, params, p_shard)
+    opt_state = jax.jit(optimizer.init, out_shardings=o_shard)(params)
     if stochastic_round:
-        # Init the moments from an fp32 VIEW of the (bf16) params: the
-        # step updates them with fp32 grads, so fp32-from-step-0 keeps
-        # the opt_state aval stable — a bf16 init would force a second
-        # full XLA compile on the first real step.
-        def init_fn(p):
-            return optimizer.init(
-                jax.tree.map(lambda x: x.astype(jnp.float32), p))
-    else:
-        init_fn = optimizer.init
-    o_shard = opt_state_shardings(optimizer, params, p_shard,
-                                  init_fn=init_fn)
-    opt_state = jax.jit(init_fn, out_shardings=o_shard)(params)
-    if stochastic_round:
+        # State dtypes follow the (bf16) params: optax's factored-rms
+        # update casts its moments back to the param dtype each step, so
+        # a bf16-init state is STABLE from step 1 (one compile, donated
+        # buffers alias in-place). Do NOT init from an fp32 view — it
+        # adds 4 un-donatable bytes/param of arguments to the first step
+        # (measured: OOMs the 2.7B tier this path exists for) and the
+        # update casts the state back down anyway.
         opt_state = (opt_state, jnp.uint32(0))
 
     def loss(params, tokens, targets):

@@ -1,0 +1,161 @@
+"""Model-based planning (AlphaZero-lite) + value-decomposition
+multi-agent (QMIX) — VERDICT r4 missing #3/#4, next #8. Refs:
+/root/reference/rllib/algorithms/alpha_zero/alpha_zero.py:1,
+rllib/algorithms/qmix/qmix.py:1.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.alpha_zero import (
+    MCTS,
+    AlphaZeroConfig,
+    TicTacToe,
+    az_forward,
+    init_az_params,
+)
+from ray_tpu.rllib.qmix import (
+    QMIXConfig,
+    TwoStepCoop,
+    agent_qs,
+    init_qmix_params,
+    mix,
+)
+
+
+class TestQMIXPieces:
+    def test_mixer_is_monotonic_in_agent_utilities(self):
+        """dQ_tot/dQ_a >= 0 everywhere — the property that makes
+        decentralized per-agent argmax consistent with the joint
+        argmax (the point of QMIX)."""
+        params = init_qmix_params(jax.random.key(0), obs_dim=3,
+                                  n_agents=2, n_actions=2, state_dim=3)
+        rng = np.random.default_rng(0)
+        for i in range(5):
+            qs = jnp.asarray(rng.normal(size=(4, 2)).astype(np.float32))
+            state = jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32))
+            grads = jax.vmap(
+                jax.grad(lambda q, s: mix(
+                    params, q[None], s[None], 2)[0]))(qs, state)
+            assert np.all(np.asarray(grads) >= 0.0), grads
+
+    def test_shared_agent_net_uses_agent_ids(self):
+        """Same obs, different agent slot → different Q rows (the
+        one-hot id disambiguates the shared net)."""
+        params = init_qmix_params(jax.random.key(1), obs_dim=3,
+                                  n_agents=2, n_actions=2, state_dim=3)
+        obs = jnp.asarray(np.ones((1, 2, 3), np.float32))
+        q = np.asarray(agent_qs(params, obs, 2))
+        assert not np.allclose(q[0, 0], q[0, 1])
+
+    def test_two_step_env_payoffs(self):
+        env = TwoStepCoop()
+        env.reset()
+        # Branch A: everyone gets 7 regardless of second-step actions.
+        env.step({"agent_0": 0, "agent_1": 0})
+        _, rew, done, _ = env.step({"agent_0": 1, "agent_1": 0})
+        assert rew["agent_0"] == 7.0 and done["agent_0"]
+        # Branch B, coordinated (1,1): the optimal 8.
+        env.step({"agent_0": 1, "agent_1": 0})
+        _, rew, done, _ = env.step({"agent_0": 1, "agent_1": 1})
+        assert rew["agent_0"] == 8.0 and done["agent_0"]
+
+
+class TestQMIXLearning:
+    def test_smoke_updates(self):
+        algo = (QMIXConfig().environment(TwoStepCoop, seed=0)
+                .training(steps_per_iteration=32, learning_starts=16)
+                .build())
+        res = None
+        for _ in range(3):
+            res = algo.train()
+        assert np.isfinite(res["loss"])
+        assert res["episode_return_mean"] is not None
+
+    @pytest.mark.slow
+    def test_solves_two_step_coordination(self):
+        """Greedy decentralized execution reaches the coordinated
+        optimum (8) that independent greedy credit assignment forgoes
+        for the safe 7."""
+        algo = QMIXConfig().environment(TwoStepCoop, seed=0).build()
+        score = 0.0
+        for _ in range(80):
+            algo.train()
+            score = algo.greedy_episode_return(10)
+            if score >= 7.9:
+                break
+        assert score >= 7.9, f"QMIX stuck at {score} (safe branch is 7)"
+
+
+class TestAlphaZeroPieces:
+    def test_tictactoe_model(self):
+        b = TicTacToe.initial()
+        assert TicTacToe.winner(b) is None
+        for a, p in ((0, 1), (3, -1), (1, 1), (4, -1)):
+            b = TicTacToe.play(b, a, p)
+        assert TicTacToe.winner(b) is None
+        assert not TicTacToe.legal(b)[0] and TicTacToe.legal(b)[2]
+        b = TicTacToe.play(b, 2, 1)       # X completes the top row
+        assert TicTacToe.winner(b) == 1
+        # Canonical encoding: the player to move always sees own pieces
+        # in the first plane.
+        e1 = TicTacToe.encode(b, 1)
+        e2 = TicTacToe.encode(b, -1)
+        np.testing.assert_array_equal(e1[:9], e2[9:])
+
+    def test_mcts_finds_immediate_win(self):
+        """With a RANDOM net, enough simulations still find the one-move
+        win — terminal values dominate the search."""
+        params = init_az_params(jax.random.key(0), 18, 9)
+        fwd = jax.jit(az_forward)
+        mcts = MCTS(lambda f: fwd(params, f), n_simulations=128,
+                    rng=np.random.default_rng(0))
+        b = TicTacToe.initial()
+        for a, p in ((0, 1), (3, -1), (1, 1), (4, -1)):
+            b = TicTacToe.play(b, a, p)
+        pi = mcts.policy(b, 1, temperature=0.0)
+        assert int(np.argmax(pi)) == 2    # completes the top row
+
+    def test_mcts_blocks_opponent_win(self):
+        params = init_az_params(jax.random.key(0), 18, 9)
+        fwd = jax.jit(az_forward)
+        mcts = MCTS(lambda f: fwd(params, f), n_simulations=256,
+                    rng=np.random.default_rng(0))
+        b = TicTacToe.initial()
+        # O threatens the left column (0, 3); X must block at 6.
+        for a, p in ((4, 1), (0, -1), (8, 1), (3, -1)):
+            b = TicTacToe.play(b, a, p)
+        pi = mcts.policy(b, 1, temperature=0.0)
+        assert int(np.argmax(pi)) == 6
+
+
+class TestAlphaZeroLearning:
+    def test_smoke_iteration(self):
+        algo = (AlphaZeroConfig()
+                .training(games_per_iteration=4, sgd_rounds_per_step=2,
+                          num_simulations=16)
+                .build())
+        res = algo.train()
+        assert res["new_positions"] > 0
+        assert np.isfinite(res["loss"])
+
+    @pytest.mark.slow
+    def test_self_play_improves_net_and_search_dominates(self):
+        """Search + trained net plays (near-)perfectly vs random, and the
+        RAW net's argmax policy — what self-play distilled INTO the net —
+        clearly improves over its untrained strength."""
+        algo = (AlphaZeroConfig()
+                .training(sgd_rounds_per_step=24, games_per_iteration=24,
+                          temperature_moves=4)
+                .build())
+        raw_before = algo.play_vs_random(20, use_search=False)
+        for _ in range(14):
+            res = algo.train()
+        raw_after = algo.play_vs_random(20, use_search=False)
+        search_after = algo.play_vs_random(20)
+        assert search_after >= 0.9, search_after
+        assert raw_after >= raw_before + 0.1, (raw_before, raw_after)
+        assert res["loss"] < 1.6
