@@ -236,6 +236,13 @@ class Config:
     llm_decode_block: int = 8
     # Finished-but-unread token streams are garbage-collected after this.
     llm_stream_ttl_s: float = 600.0
+    # KV layout: "dense" preallocates [n_slots, max_len] per slot;
+    # "paged" shares a page pool with per-slot tables + ragged attention
+    # reads (models/paged_kv.py) — more slots per GB, preempt-by-
+    # recompute under pressure. BENCH_SERVE.json measures the trade.
+    llm_kv_mode: str = "dense"
+    # Tokens per KV page in paged mode.
+    llm_kv_page_size: int = 64
 
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"

@@ -11,6 +11,12 @@ from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.alpha_zero import AlphaZero, AlphaZeroConfig
 from ray_tpu.rllib.callbacks import DefaultCallbacks
 from ray_tpu.rllib.evaluation import EvalRunner, EvalWorkerSet
+from ray_tpu.rllib.external import (
+    ExternalDQN,
+    ExternalDQNConfig,
+    PolicyClient,
+    PolicyServerActor,
+)
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TwoStepCoop
 from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.dqn import DQN, DQNConfig
@@ -77,7 +83,8 @@ __all__ = [
     "MultiAgentPPOConfig", "JsonReader", "JsonWriter", "OfflineDQN",
     "collect_dataset",
     "AlphaZero", "AlphaZeroConfig", "QMIX", "QMIXConfig", "TwoStepCoop",
-    "R2D2", "R2D2Config",
+    "R2D2", "R2D2Config", "ExternalDQN", "ExternalDQNConfig",
+    "PolicyClient", "PolicyServerActor",
     "DefaultCallbacks", "EvalRunner", "EvalWorkerSet",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
     "ReplayBuffer", "PrioritizedReplayBuffer", "VectorEnv", "CartPole",

@@ -22,6 +22,7 @@ import math
 
 import numpy as np
 
+from ray_tpu.rllib.algorithm import AlgorithmConfig
 from ray_tpu.rllib.policy import _init_mlp, _mlp
 
 
@@ -168,10 +169,14 @@ class MCTS:
         return n / n.sum()
 
 
-class AlphaZeroConfig:
+class AlphaZeroConfig(AlgorithmConfig):
+    """Fluent config in the AlgorithmConfig hierarchy (environment /
+    training / build / copy come from the base; the rollout fields are
+    unused — self-play IS the rollout here)."""
+
     def __init__(self):
+        super().__init__()
         self.env = TicTacToe
-        self.env_seed = 0
         self.lr = 3e-3
         self.hidden = 64
         self.num_simulations = 48
@@ -182,21 +187,6 @@ class AlphaZeroConfig:
         self.sgd_rounds_per_step = 8
         self.buffer_size = 8192
         self.weight_decay = 1e-4
-
-    def environment(self, env, *, seed: int = 0) -> "AlphaZeroConfig":
-        self.env = env
-        self.env_seed = seed
-        return self
-
-    def training(self, **kw) -> "AlphaZeroConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise AttributeError(f"unknown option {k!r}")
-            setattr(self, k, v)
-        return self
-
-    def build(self) -> "AlphaZero":
-        return AlphaZero(self)
 
 
 class AlphaZero:
@@ -344,6 +334,8 @@ class AlphaZero:
     def stop(self) -> None:
         pass
 
+
+AlphaZeroConfig.algo_class = AlphaZero
 
 __all__ = ["AlphaZero", "AlphaZeroConfig", "MCTS", "TicTacToe",
            "init_az_params", "az_forward"]

@@ -1,0 +1,268 @@
+"""External-env / policy-server RL: training driven by an environment
+the framework does not step.
+
+Parity: `/root/reference/rllib/env/external_env.py:1` (inverted
+control: the external application queries the policy and logs
+rewards) and `rllib/env/policy_server_input.py:1` (the server as an
+experience source for the learner). VERDICT r4 missing #6.
+
+TPU-native shape: the server is an ACTOR on the runtime's RPC plane
+(`PolicyServerActor`) rather than a bespoke HTTP server — external
+Python applications connect with `PolicyClient` from any driver
+attached to the cluster (for non-Python/REST ingress, front it with a
+serve deployment; the actor API is the core contract). The learner
+(`ExternalDQN`) never steps an env: each training iteration it pushes
+fresh Q-weights to the server, drains the transitions external
+episodes produced, and runs the standard replay/TD updates — DQN's
+off-policyness is what makes externally-paced, stale-policy experience
+safe to learn from.
+
+The algorithm's `env` setting is used ONLY for spaces and evaluation;
+sampling comes exclusively from external clients.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class PolicyServerActor:
+    """Serves actions from the latest pushed weights and assembles the
+    externally-driven episodes into flat transition rows.
+
+    Episode protocol (per external episode, serially):
+      eid = start_episode()
+      a   = get_action(eid, obs)        # on-policy (server's epsilon-greedy)
+      log_action(eid, obs, a)           # or: off-policy action taken by the app
+      log_returns(eid, reward)          # any time after an action
+      end_episode(eid, last_obs)
+    """
+
+    def __init__(self, *, n_actions: int, hiddens=(64, 64), seed: int = 0,
+                 epsilon: float = 0.05):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        self.n_actions = n_actions
+        self.hiddens = tuple(hiddens)
+        self.epsilon = epsilon
+        self.params = None
+        self._q = None
+        self._rng = np.random.default_rng(seed)
+        # eid → {"obs": last obs, "action": last action, "reward": acc}
+        self._open: dict[str, dict] = {}
+        self._rows: list[dict] = []
+        self.episode_returns: list[float] = []
+
+    # ---- learner side ----
+
+    def set_weights(self, weights, *, dueling: bool = False,
+                    atoms: int = 1, z=None) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.dqn import q_values
+
+        self.params = jax.device_put(weights)
+        if self._q is None:
+            zz = None if z is None else jnp.asarray(np.asarray(z))
+            self._q = jax.jit(lambda p, o: q_values(
+                p, o, dueling=dueling, atoms=atoms,
+                n_actions=self.n_actions, z=zz))
+
+    def drain(self) -> SampleBatch:
+        """Matured transition rows since the last drain."""
+        rows, self._rows = self._rows, []
+        if not rows:
+            return SampleBatch({sb.OBS: np.zeros((0, 1), np.float32)})
+        return SampleBatch({
+            sb.OBS: np.stack([r["obs"] for r in rows]),
+            sb.ACTIONS: np.asarray([r["action"] for r in rows], np.int64),
+            sb.REWARDS: np.asarray([r["reward"] for r in rows], np.float32),
+            sb.DONES: np.asarray([r["done"] for r in rows]),
+            sb.NEXT_OBS: np.stack([r["next_obs"] for r in rows]),
+        })
+
+    def metrics(self, window: int = 100) -> dict:
+        recent = self.episode_returns[-window:]
+        return {"episode_return_mean":
+                float(np.mean(recent)) if recent else None,
+                "episodes_total": len(self.episode_returns),
+                "open_episodes": len(self._open)}
+
+    # ---- external-application side ----
+
+    def start_episode(self) -> str:
+        eid = uuid.uuid4().hex[:12]
+        self._open[eid] = {"obs": None, "action": None, "reward": 0.0,
+                           "return": 0.0}
+        return eid
+
+    def get_action(self, eid: str, obs) -> int:
+        """On-policy serving: epsilon-greedy on the pushed Q-net."""
+        import jax.numpy as jnp
+
+        if self.params is None:
+            action = int(self._rng.integers(0, self.n_actions))
+        elif self._rng.random() < self.epsilon:
+            action = int(self._rng.integers(0, self.n_actions))
+        else:
+            flat = np.asarray(obs, np.float32).reshape(1, -1)
+            q = np.asarray(self._q(self.params, jnp.asarray(flat)))[0]
+            action = int(q.argmax())
+        self.log_action(eid, obs, action)
+        return action
+
+    def log_action(self, eid: str, obs, action: int) -> None:
+        """Record (obs, action); also closes the previous transition with
+        `obs` as its successor."""
+        ep = self._open[eid]
+        obs = np.asarray(obs, np.float32)
+        self._mature(ep, next_obs=obs, done=False)
+        ep["obs"] = obs
+        ep["action"] = int(action)
+
+    def log_returns(self, eid: str, reward: float) -> None:
+        ep = self._open[eid]
+        ep["reward"] += float(reward)
+        ep["return"] += float(reward)
+
+    def end_episode(self, eid: str, last_obs) -> None:
+        ep = self._open.pop(eid)
+        self._mature(ep, next_obs=np.asarray(last_obs, np.float32),
+                     done=True)
+        self.episode_returns.append(ep["return"])
+
+    def _mature(self, ep: dict, *, next_obs, done: bool) -> None:
+        if ep["obs"] is None:
+            return
+        self._rows.append({
+            "obs": ep["obs"], "action": ep["action"],
+            "reward": ep["reward"], "done": done, "next_obs": next_obs,
+        })
+        ep["reward"] = 0.0
+        ep["obs"] = None
+        ep["action"] = None
+
+
+class PolicyClient:
+    """Thin sync wrapper an external application uses against the server
+    actor (ref: rllib/env/policy_client.py remote inference mode)."""
+
+    def __init__(self, server):
+        self._server = server
+
+    def start_episode(self) -> str:
+        return ray_tpu.get(self._server.start_episode.remote(), timeout=60)
+
+    def get_action(self, eid: str, obs):
+        return ray_tpu.get(
+            self._server.get_action.remote(eid, np.asarray(obs)),
+            timeout=60)
+
+    def log_action(self, eid: str, obs, action) -> None:
+        ray_tpu.get(self._server.log_action.remote(
+            eid, np.asarray(obs), int(action)), timeout=60)
+
+    def log_returns(self, eid: str, reward: float) -> None:
+        ray_tpu.get(self._server.log_returns.remote(eid, float(reward)),
+                    timeout=60)
+
+    def end_episode(self, eid: str, obs) -> None:
+        ray_tpu.get(self._server.end_episode.remote(eid, np.asarray(obs)),
+                    timeout=60)
+
+
+class ExternalDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_rollout_workers = 0
+        # Serving-side exploration (the server's epsilon-greedy).
+        self.serving_epsilon = 0.1
+        # Updates per train() iteration (no env stepping happens).
+        self.sgd_rounds_per_step = 16
+
+
+class ExternalDQN(DQN):
+    """DQN fed exclusively by a PolicyServerActor: `config.env` supplies
+    spaces + evaluation only; experience arrives from external clients
+    via `algo.server` (a started actor handle)."""
+
+    @classmethod
+    def get_default_config(cls) -> ExternalDQNConfig:
+        return ExternalDQNConfig()
+
+    def setup(self) -> None:
+        import jax
+
+        super().setup()
+        cfg: ExternalDQNConfig = self.config
+        server_cls = ray_tpu.remote(PolicyServerActor)
+        self.server = server_cls.remote(
+            n_actions=self.n_actions, hiddens=tuple(cfg.model_hiddens),
+            seed=cfg.env_seed, epsilon=cfg.serving_epsilon)
+        self._push_weights()
+
+    def _push_weights(self) -> None:
+        import jax
+
+        cfg: ExternalDQNConfig = self.config
+        ray_tpu.get(self.server.set_weights.remote(
+            jax.device_get(self.params), dueling=cfg.dueling,
+            atoms=self.atoms,
+            z=None if self.atoms == 1 else np.asarray(self._z)),
+            timeout=60)
+
+    def training_step(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        cfg: ExternalDQNConfig = self.config
+        batch = ray_tpu.get(self.server.drain.remote(), timeout=60)
+        if batch.count:
+            self.buffer.add(batch)
+            self._timesteps_total += batch.count
+        loss = None
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.sgd_rounds_per_step):
+                mb = self.buffer.sample(256)
+                weights = jnp.asarray(mb.get(
+                    "weights", np.ones(mb.count, np.float32)))
+                dev = {k: jnp.asarray(v) for k, v in mb.items()
+                       if k not in ("weights", "batch_indexes")}
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.opt_state, self.target_params, dev,
+                    weights)
+                if cfg.prioritized_replay:
+                    self.buffer.update_priorities(
+                        mb["batch_indexes"], np.asarray(td))
+                self._since_target_sync += 256
+            if self._since_target_sync >= cfg.target_update_freq:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+                self._since_target_sync = 0
+        self._push_weights()
+        m = ray_tpu.get(self.server.metrics.remote(), timeout=60)
+        return {"loss": None if loss is None else float(loss),
+                "buffer_size": len(self.buffer),
+                "episode_return_mean": m["episode_return_mean"],
+                "external_episodes": m["episodes_total"]}
+
+    def stop(self) -> None:
+        try:
+            ray_tpu.kill(self.server)
+        except Exception:
+            pass
+        super().stop()
+
+
+ExternalDQNConfig.algo_class = ExternalDQN
+
+__all__ = ["PolicyServerActor", "PolicyClient", "ExternalDQN",
+           "ExternalDQNConfig"]

@@ -155,9 +155,6 @@ class QMIXConfig(AlgorithmConfig):
         self.mix_embed = 32
         self.double_q = True
 
-    def build(self) -> "QMIX":
-        return QMIX(self)
-
 
 class QMIX:
     """Replay-based QMIX over a MultiAgentEnv with a team reward.
@@ -284,20 +281,32 @@ class QMIX:
                         for i, a in enumerate(self.agent_ids)}
             next_obs, rew, done, trunc = self.env.step(act_dict)
             team_r = float(sum(rew.values()) / self.n_agents)
-            team_done = any(done.values()) or any(trunc.values())
+            terminated = any(done.values())
+            truncated = any(trunc.values()) and not terminated
+            finished = terminated or truncated
             self.obs = next_obs
             next_state = self._state()
+            # Time-limit handling (matches dqn.py): a finished row stores
+            # the PRE-reset successor obs (env.final_obs) — next_obs is
+            # already the fresh episode's reset obs — and only TERMINAL
+            # rows set dones, so truncated transitions still bootstrap
+            # through their successor value.
+            stored_next = next_obs
+            if finished:
+                fin = getattr(self.env, "final_obs", None) or {}
+                stored_next = {a: fin.get(a, next_obs[a])
+                               for a in self.agent_ids}
             self.buffer.add(SampleBatch({
                 "obs": obs_mat[None],
-                "next_obs": self._obs_mat(next_obs)[None],
+                "next_obs": self._obs_mat(stored_next)[None],
                 "state": state[None],
                 "next_state": next_state[None],
                 "actions": acts[None].astype(np.int64),
                 "rewards": np.asarray([team_r], np.float32),
-                "dones": np.asarray([team_done]),
+                "dones": np.asarray([terminated]),
             }))
             self._running += team_r
-            if team_done:
+            if finished:
                 self.episode_returns.append(self._running)
                 self._running = 0.0
             self._timesteps += 1
@@ -344,7 +353,10 @@ class QMIX:
                 if any(done.values()) or any(trunc.values()):
                     break
             totals.append(total)
+        # Eval interrupted an in-flight training episode: drop its
+        # partial return too, or it would leak into the next logged one.
         self.obs = self.env.reset()
+        self._running = 0.0
         return float(np.mean(totals))
 
     def stop(self) -> None:

@@ -56,7 +56,7 @@ class LLMEngine:
                  max_len: int = 2048, seed: int = 0,
                  prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
                  decode_block: int | None = None,
-                 kv_mode: str = "dense", page_size: int = 64,
+                 kv_mode: str | None = None, page_size: int | None = None,
                  n_pages: int | None = None):
         import jax
 
@@ -75,6 +75,13 @@ class LLMEngine:
         self.buckets = buckets
         self.params = params if params is not None else gpt.init_params(
             cfg, jax.random.key(seed))
+        if kv_mode is None or page_size is None:
+            from ray_tpu.core.config import runtime_config
+
+            _rc = runtime_config()
+            kv_mode = _rc.llm_kv_mode if kv_mode is None else kv_mode
+            page_size = (_rc.llm_kv_page_size if page_size is None
+                         else page_size)
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
         self.kv_mode = kv_mode

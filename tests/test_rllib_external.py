@@ -1,0 +1,147 @@
+"""External-env / policy-server RL (VERDICT r4 missing #6; ref:
+/root/reference/rllib/env/external_env.py:1,
+rllib/env/policy_server_input.py:1): the application drives episodes
+and queries the server; the learner never steps an env.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.external import (
+    ExternalDQNConfig,
+    PolicyClient,
+    PolicyServerActor,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestServerProtocol:
+    def test_transition_assembly(self):
+        """obs/next_obs chaining, reward attribution to the PRECEDING
+        action, terminal flag on end_episode."""
+        srv = PolicyServerActor(n_actions=2, seed=0)
+        eid = srv.start_episode()
+        o = [np.full(4, i, np.float32) for i in range(4)]
+        srv.log_action(eid, o[0], 1)
+        srv.log_returns(eid, 1.0)
+        srv.log_action(eid, o[1], 0)
+        srv.log_returns(eid, 0.5)
+        srv.log_returns(eid, 0.25)
+        srv.log_action(eid, o[2], 1)
+        srv.end_episode(eid, o[3])
+        batch = srv.drain()
+        assert batch.count == 3
+        np.testing.assert_array_equal(batch["obs"], np.stack(o[:3]))
+        np.testing.assert_array_equal(batch["next_obs"], np.stack(o[1:]))
+        assert list(batch["actions"]) == [1, 0, 1]
+        np.testing.assert_allclose(batch["rewards"], [1.0, 0.75, 0.0])
+        assert list(batch["dones"]) == [False, False, True]
+        assert srv.metrics()["episode_return_mean"] == 1.75
+        # Drained rows are gone; a fresh episode starts clean.
+        assert srv.drain().count == 0
+
+    def test_get_action_serves_pushed_weights(self):
+        import jax
+
+        from ray_tpu.rllib.dqn import init_q_params
+
+        srv = PolicyServerActor(n_actions=3, hiddens=(8,), seed=0,
+                                epsilon=0.0)
+        srv.set_weights(jax.device_get(
+            init_q_params(jax.random.key(0), 4, 3, (8,))))
+        eid = srv.start_episode()
+        a = srv.get_action(eid, np.zeros(4, np.float32))
+        assert a in (0, 1, 2)
+        srv.end_episode(eid, np.ones(4, np.float32))
+        assert srv.drain().count == 1
+
+
+class TestExternalDQN:
+    def _drive(self, algo, stop_event, n_threads=3):
+        """External application: CartPole episodes via PolicyClient."""
+        from ray_tpu.rllib.env import make_env
+
+        client = PolicyClient(algo.server)
+
+        def run(seed):
+            env = make_env("CartPole-v1", num_envs=1, seed=seed)
+            while not stop_event.is_set():
+                eid = client.start_episode()
+                obs = env.reset()[0]
+                for _ in range(500):
+                    a = client.get_action(eid, obs)
+                    nxt, r, done, trunc = env.step(np.array([a]))
+                    client.log_returns(eid, float(r[0]))
+                    obs = nxt[0]
+                    if done[0] or trunc[0] or stop_event.is_set():
+                        break
+                client.end_episode(eid, obs)
+
+        threads = [threading.Thread(target=run, args=(17 * i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        return threads
+
+    def test_smoke_learns_from_external_experience(self, cluster):
+        cfg = (ExternalDQNConfig()
+               .environment("CartPole-v1", seed=0)
+               .training(learning_starts=64, sgd_rounds_per_step=4))
+        algo = cfg.build()
+        stop = threading.Event()
+        threads = self._drive(algo, stop)
+        try:
+            res = None
+            for _ in range(60):   # externally-paced: loop until the
+                res = algo.train()  # clients have fed enough experience
+                if (res["buffer_size"] > 64
+                        and res["external_episodes"] > 0):
+                    break
+                import time
+
+                time.sleep(0.5)
+            assert res["external_episodes"] > 0
+            assert res["buffer_size"] > 64
+            assert res["loss"] is None or np.isfinite(res["loss"])
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            algo.stop()
+
+    @pytest.mark.slow
+    def test_learns_cartpole_externally(self, cluster):
+        """The acceptance bar: training driven ENTIRELY by an environment
+        the framework doesn't step reaches clearly-learned CartPole."""
+        cfg = (ExternalDQNConfig()
+               .environment("CartPole-v1", seed=0)
+               .training(learning_starts=256, sgd_rounds_per_step=16,
+                         serving_epsilon=0.15)
+               .evaluation(evaluation_duration=10))
+        algo = cfg.build()
+        stop = threading.Event()
+        threads = self._drive(algo, stop)
+        try:
+            best = 0.0
+            for _ in range(60):
+                algo.train()
+                em = algo.evaluate()
+                best = max(best, em["episode_return_mean"])
+                if best >= 150.0:
+                    break
+            assert best >= 150.0, f"external DQN best eval {best}"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            algo.stop()
