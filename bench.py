@@ -232,7 +232,10 @@ def main() -> None:
         if bench_opt == "adafactor_sr":
             import dataclasses
 
-            optimizer = optax.adafactor(3e-4)
+            optimizer = optax.adafactor(
+                3e-4,
+                multiply_by_parameter_scale=not os.environ.get(
+                    "BENCH_AF_NOSCALE"))
             stochastic_round = True
             cfg = dataclasses.replace(cfg, param_dtype=jnp.bfloat16)
         elif bench_opt == "adafactor":

@@ -17,6 +17,7 @@ from ray_tpu.rllib.external import (
     PolicyClient,
     PolicyServerActor,
 )
+from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, ContinuousMeet
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TwoStepCoop
 from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.dqn import DQN, DQNConfig
@@ -84,6 +85,7 @@ __all__ = [
     "collect_dataset",
     "AlphaZero", "AlphaZeroConfig", "QMIX", "QMIXConfig", "TwoStepCoop",
     "R2D2", "R2D2Config", "ExternalDQN", "ExternalDQNConfig",
+    "MADDPG", "MADDPGConfig", "ContinuousMeet",
     "PolicyClient", "PolicyServerActor",
     "DefaultCallbacks", "EvalRunner", "EvalWorkerSet",
     "Policy", "RolloutWorker", "WorkerSet", "SampleBatch", "compute_gae",
