@@ -15,7 +15,12 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 TIER="${1:-fast}"
-ARGS=(-q -p no:cacheprovider -n 4 --dist loadfile --max-worker-restart 0)
+ARGS=(-q -p no:cacheprovider)
+# Shard only when pytest-xdist is actually available (some driver
+# containers ship bare pytest; the tiers must still run there).
+if python -c "import xdist" 2>/dev/null; then
+  ARGS+=(-n 4 --dist loadfile --max-worker-restart 0)
+fi
 TARGET=(tests/)
 case "$TIER" in
   fast) ARGS+=(-m "not slow") ;;
@@ -34,6 +39,7 @@ case "$TIER" in
       tests/test_data.py              # Data: blocks, ops, shuffles
       tests/test_serve.py             # Serve: deploy/route/batch/HTTP
       tests/test_serve_config.py      # Serve: YAML config + REST ops
+      tests/test_tracing.py           # distributed tracing across hops
       tests/test_llm_serve.py         # LLM engine: paged KV, batching
       tests/test_tune.py              # Tune: schedulers/searchers
       tests/test_workflow.py          # Workflows: DAG + resume
@@ -43,5 +49,15 @@ case "$TIER" in
     ) ;;
   *) echo "usage: $0 [fast|full|quick]" >&2; exit 2 ;;
 esac
+
+# Collection guard: a silent import/collection error in the tracing module
+# would just shrink the pass count — pytest's grep-style pass totals can't
+# tell "all passed" from "never collected". Fail loudly instead.
+collected=$(python -m pytest tests/test_tracing.py --collect-only -q \
+  -p no:cacheprovider 2>/dev/null | grep -c '^tests/test_tracing.py' || true)
+if [ "${collected}" -eq 0 ]; then
+  echo "FATAL: tests/test_tracing.py collected zero tests" >&2
+  exit 1
+fi
 
 exec python -m pytest "${TARGET[@]}" "${ARGS[@]}"

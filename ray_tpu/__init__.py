@@ -26,8 +26,8 @@ def __getattr__(name):
         from ray_tpu import exceptions
 
         return getattr(exceptions, name)
-    if name == "timeline":
-        from ray_tpu.state import timeline
+    if name in ("timeline", "list_traces", "get_trace"):
+        from ray_tpu import state
 
-        return timeline
+        return getattr(state, name)
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

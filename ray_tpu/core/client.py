@@ -21,6 +21,7 @@ import time
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Sequence
 
+from ray_tpu import tracing
 from ray_tpu.core import rpc, serialization
 from ray_tpu.core.config import Config
 from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
@@ -183,9 +184,35 @@ class CoreClient:
             self._run(self._start_ref_flusher())
         else:
             self.refcounter._closed = True
+        # Drivers ship their profiling spans/metrics to the GCS themselves
+        # (a root span recorded with tracing.start_span would otherwise be
+        # visible only in this process and every remote reader would see an
+        # orphaned trace). Worker processes already run the worker-side
+        # flush loop (core/worker.py) over the same buffer — skip there.
+        if not os.environ.get("RAY_TPU_WORKER_ID"):
+            self._spawn_bg(self._obs_flush_loop())
 
     async def _start_ref_flusher(self):
         self.refcounter.start(self.config.ref_flush_interval_s)
+
+    async def _obs_flush_loop(self) -> None:
+        """Driver-side observability flush (shared loop body in
+        profiling.run_obs_flush_loop): ships this process's profiling
+        spans and metric snapshots to the GCS so driver-rooted traces and
+        driver-recorded metrics are visible to every reader, not just
+        local ones. The source carries a session nonce — PIDs collide
+        across hosts and driver restarts, and the GCS seq dedupe keyed on
+        a reused source would silently discard the newcomer's batches."""
+        import uuid
+
+        from ray_tpu import profiling
+
+        await profiling.run_obs_flush_loop(
+            f"client:{os.getpid()}:{uuid.uuid4().hex[:8]}",
+            lambda method, p: self.gcs.call(
+                method, p, timeout=self.config.rpc_default_timeout_s),
+            self.config.worker_profile_flush_interval_s,
+            lambda: self._closed)
 
     # ------------------------------------------------------------ plumbing
 
@@ -883,6 +910,10 @@ class CoreClient:
             ),
             scheduling_strategy=scheduling_strategy,
             runtime_env=runtime_env,
+            # Captured HERE (the submitting thread) so the ambient trace
+            # context of the caller — not of the client's event loop —
+            # parents this task's span.
+            trace_ctx=tracing.capture_for_submission(),
         )
         for rid in return_ids:
             self._result_events[rid] = threading.Event()
@@ -1393,10 +1424,13 @@ class CoreClient:
         st = ActorState(actor_id)
         st.resources = resources
         self._actors[actor_id] = st
+        # Trace capture must happen in the SUBMITTING thread — the coroutine
+        # below runs on the client's event loop, whose context is empty.
+        trace_ctx = tracing.capture_for_submission()
         result = self._run(self._create_actor_async(
             st, cls_blob, name, args, kwargs, resources, hold_resources,
             max_restarts, max_concurrency, actor_name, get_if_exists,
-            runtime_env, concurrency_groups, max_task_retries,
+            runtime_env, concurrency_groups, max_task_retries, trace_ctx,
         ))
         if isinstance(result, bytes):       # got existing named actor
             return result
@@ -1406,6 +1440,7 @@ class CoreClient:
         self, st, cls_blob, name, args, kwargs, resources, hold_resources,
         max_restarts, max_concurrency, actor_name, get_if_exists,
         runtime_env=None, concurrency_groups=None, max_task_retries=0,
+        trace_ctx=None,
     ):
         task_id = TaskID.for_actor_task(ActorID(st.actor_id))
         arg_specs, kw_keys, escrow = self._build_args(args, kwargs)
@@ -1429,6 +1464,7 @@ class CoreClient:
             actor_name=actor_name,
             runtime_env=runtime_env,
             concurrency_groups=concurrency_groups,
+            trace_ctx=trace_ctx,
         )
         reg = await self.gcs.call("register_actor", {
             "actor_id": st.actor_id,
@@ -1573,6 +1609,7 @@ class CoreClient:
             method_name=method_name,
             concurrency_group=concurrency_group,
             max_retries=max_task_retries,
+            trace_ctx=tracing.capture_for_submission(),
         )
         for rid in return_ids:
             self._result_events[rid] = threading.Event()

@@ -93,7 +93,25 @@ class GcsServer:
         self.placement_groups: dict[bytes, dict] = {}
         # Observability (ref: gcs_service.proto AddProfileData; metrics hub)
         self.profile_events: list = []
-        self.metrics_by_source: dict[str, list] = {}
+        # Cluster-wide drop tally: per-process buffer drops reported by
+        # flushes + events this table itself had no room for.
+        self.profile_events_dropped = 0
+        # source → last applied batch seq: a flusher retrying a batch whose
+        # first attempt timed out AFTER applying must not double-insert.
+        self.profile_seq_by_source: dict[str, int] = {}
+        # Incremental trace views, maintained at insert time so polled
+        # trace endpoints are O(result), not an O(table) scan on the
+        # control-plane event loop.
+        self.profile_by_trace: dict[str, list] = {}
+        self.trace_summaries: dict[str, dict] = {}
+        # source → (last push wall time, rows). Sources are per-session
+        # (each driver run flushes under a fresh nonce): without expiry the
+        # hub would grow one snapshot per job forever and keep exporting
+        # dead drivers' stale gauges — see _sweep_stale_sources.
+        self.metrics_by_source: dict[str, tuple[float, list]] = {}
+        # Final counter/histogram rows of expired sources (totals must
+        # survive their process); stale gauges are dropped with the source.
+        self.metrics_retired: list[dict] = []
         # ---- distributed ref counting (ref: reference_count.h) ----
         # Runtime state, deliberately NOT snapshotted: holders re-register
         # their full held sets on reconnect after a GCS failover.
@@ -196,6 +214,8 @@ class GcsServer:
         s.register("events_get", self._h_events_get)
         s.register("profile_add", self._profile_add)
         s.register("profile_get", self._profile_get)
+        s.register("profile_stats", self._profile_stats)
+        s.register("profile_traces", self._profile_traces)
         s.register("metrics_push", self._metrics_push)
         s.register("metrics_get", self._metrics_get)
         s.on_disconnect(self._handle_disconnect)
@@ -449,24 +469,95 @@ class GcsServer:
     # ---------- observability ----------
 
     MAX_PROFILE_EVENTS = 200_000
+    METRICS_SOURCE_TTL_S = 600.0
+    MAX_RETIRED_METRIC_ROWS = 10_000
+
+    def _index_profile_event(self, e: dict) -> None:
+        """Fold one accepted event into the per-trace index + summary."""
+        a = e.get("args") or {}
+        trace_id = a.get("trace_id")
+        if not trace_id:
+            return
+        self.profile_by_trace.setdefault(trace_id, []).append(e)
+        end = e["ts"] + e.get("dur", 0)
+        s = self.trace_summaries.get(trace_id)
+        if s is None:
+            s = self.trace_summaries[trace_id] = {
+                "trace_id": trace_id, "num_spans": 0, "root": e["name"],
+                "start_ts_us": e["ts"], "_end": end, "_root_ts": None,
+            }
+        s["num_spans"] += 1
+        s["start_ts_us"] = min(s["start_ts_us"], e["ts"])
+        s["_end"] = max(s["_end"], end)
+        if not a.get("parent_span_id") and (
+                s["_root_ts"] is None or e["ts"] < s["_root_ts"]):
+            s["root"], s["_root_ts"] = e["name"], e["ts"]
+        s["duration_s"] = round((s["_end"] - s["start_ts_us"]) / 1e6, 6)
 
     async def _profile_add(self, conn, p):
-        room = self.MAX_PROFILE_EVENTS - len(self.profile_events)
-        if room > 0:
-            self.profile_events.extend(p["events"][:room])
+        source, seq = p.get("source"), p.get("seq")
+        if source is not None and seq is not None:
+            if seq <= self.profile_seq_by_source.get(source, 0):
+                return {"ok": True, "dup": True}
+            self.profile_seq_by_source[source] = seq
+        events = p["events"]
+        room = max(0, self.MAX_PROFILE_EVENTS - len(self.profile_events))
+        accepted = events[:room] if room > 0 else []
+        self.profile_events.extend(accepted)
+        for e in accepted:
+            self._index_profile_event(e)
+        self.profile_events_dropped += (
+            len(events) - len(accepted) + int(p.get("dropped", 0)))
         return {"ok": True}
 
     async def _profile_get(self, conn, p):
-        return self.profile_events
+        trace_id = (p or {}).get("trace_id")
+        # Server-side trace filter via the insert-time index: a polled
+        # get_trace() costs O(trace), never an O(table) scan/transfer.
+        events = (self.profile_events if trace_id is None
+                  else self.profile_by_trace.get(trace_id, []))
+        return {"events": events,
+                "dropped": self.profile_events_dropped}
+
+    async def _profile_stats(self, conn, p):
+        """Tally-only view: pollers must not move the whole event table."""
+        return {"count": len(self.profile_events),
+                "dropped": self.profile_events_dropped}
+
+    async def _profile_traces(self, conn, p):
+        """Per-trace summary rows (newest first), maintained incrementally
+        at insert time — only the small summaries go over the wire."""
+        rows = [{k: v for k, v in s.items() if not k.startswith("_")}
+                for s in self.trace_summaries.values()]
+        rows.sort(key=lambda r: -r["start_ts_us"])
+        return rows
+
+    def _sweep_stale_sources(self) -> None:
+        """Expire per-session metric sources (drivers come and go): their
+        final counter/histogram rows are retired so totals survive, stale
+        gauges drop, and the seq-dedupe entry is released."""
+        now = time.time()
+        for source, (ts, rows) in list(self.metrics_by_source.items()):
+            if now - ts <= self.METRICS_SOURCE_TTL_S:
+                continue
+            self.metrics_retired.extend(
+                {**r, "tags": {**r.get("tags", {}), "source": source}}
+                for r in rows if r.get("kind") != "gauge")
+            del self.metrics_by_source[source]
+            self.profile_seq_by_source.pop(source, None)
+        if len(self.metrics_retired) > self.MAX_RETIRED_METRIC_ROWS:
+            del self.metrics_retired[
+                : len(self.metrics_retired) - self.MAX_RETIRED_METRIC_ROWS]
 
     async def _metrics_push(self, conn, p):
         # Latest snapshot per source process replaces the previous one.
-        self.metrics_by_source[p["source"]] = p["rows"]
+        self.metrics_by_source[p["source"]] = (time.time(), p["rows"])
         return {"ok": True}
 
     async def _metrics_get(self, conn, p):
-        out = []
-        for source, rows in self.metrics_by_source.items():
+        self._sweep_stale_sources()
+        out = list(self.metrics_retired)
+        for source, (_ts, rows) in self.metrics_by_source.items():
             for r in rows:
                 out.append({**r, "tags": {**r.get("tags", {}),
                                           "source": source}})

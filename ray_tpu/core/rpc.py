@@ -194,7 +194,9 @@ class Server:
         self.dedupe_exempt: set[str] = {
             "heartbeat", "get_cluster_view", "kv_get", "kv_keys", "obj_loc_get",
             "store_get", "store_contains", "obj_read_chunk", "obj_info",
-            "profile_get", "metrics_get", "ref_update", "ref_register_holder",
+            "profile_get", "profile_stats", "profile_traces", "metrics_get",
+            "ref_update",
+            "ref_register_holder",
             "ref_revive",
             "subscribe", "get_actor", "list_actors", "pg_get", "pg_list",
         }

@@ -63,3 +63,9 @@ class TaskSpec:
     placement_group_id: bytes | None = None
     placement_group_bundle_index: int = -1
     runtime_env: dict | None = None
+    # Distributed-tracing carrier captured at .remote() time (tracing.py:
+    # trace_id / span_id / parent_span_id / baggage / submitted_at). The
+    # executing worker restores it as the ambient context so nested
+    # submissions chain, and stamps the per-hop timing breakdown back into
+    # it for the task's profiling span. None = untraced submission.
+    trace_ctx: dict | None = None

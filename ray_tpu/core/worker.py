@@ -253,25 +253,16 @@ class Worker:
 
     async def _obs_flush_loop(self) -> None:
         """Ship buffered profile events + metric snapshots to the GCS
-        (ref: core_worker/profiling.cc batching to AddProfileData)."""
+        (ref: core_worker/profiling.cc batching to AddProfileData).
+        Shared loop body in profiling.run_obs_flush_loop."""
         from ray_tpu import profiling
 
-        source = f"worker:{WorkerID(self.worker_id).hex()[:8]}"
-        while not self._exit.is_set():
-            await asyncio.sleep(
-                self.config.worker_profile_flush_interval_s)
-            try:
-                events = profiling.drain_events()
-                if events:
-                    await self.gcs.call("profile_add", {"events": events},
-                                        timeout=self.config.rpc_default_timeout_s)
-                rows = profiling.metrics_snapshot()
-                if rows:
-                    await self.gcs.call(
-                        "metrics_push", {"source": source, "rows": rows},
-                        timeout=self.config.rpc_default_timeout_s)
-            except Exception:
-                pass
+        await profiling.run_obs_flush_loop(
+            f"worker:{WorkerID(self.worker_id).hex()[:8]}",
+            lambda method, p: self.gcs.call(
+                method, p, timeout=self.config.rpc_default_timeout_s),
+            self.config.worker_profile_flush_interval_s,
+            self._exit.is_set)
 
     async def _h_push_task(self, conn, p):
         from ray_tpu import profiling
@@ -327,10 +318,14 @@ class Worker:
                 self.task_pool, self._run_normal_task, spec
             )
             results, error = await fut
+        from ray_tpu import tracing
+
         profiling.record_event(
             spec.method_name or spec.name, spec.kind, _t0, time.time() - _t0,
             pid=f"node:{self.node_id.hex()[:8]}",
-            tid=f"worker:{WorkerID(self.worker_id).hex()[:8]}")
+            tid=f"worker:{WorkerID(self.worker_id).hex()[:8]}",
+            args=(tracing.carrier_event_args(spec.trace_ctx)
+                  if spec.trace_ctx else None))
         reply: dict[str, Any] = {"status": "ok", "worker_id": self.worker_id}
         if error is not None:
             reply["status"] = "error"
@@ -402,17 +397,30 @@ class Worker:
         return args, kwargs
 
     def _run_normal_task(self, spec: TaskSpec):
+        from ray_tpu import tracing
+
         self.current_task_id = spec.task_id
         self._running[spec.task_id] = ("thread", threading.get_ident())
         execution_context.current_task_id.set(spec.task_id)
         restore = None
+        # Always set (even to None): pooled threads must not leak a prior
+        # task's trace context into this task's nested submissions.
+        trace_token = tracing.enter_task(spec.trace_ctx)
         try:
             from ray_tpu.core.runtime_env import apply_runtime_env
 
             restore = apply_runtime_env(spec.runtime_env)
             fn = serialization.unpack(spec.fn_blob)
+            _t = time.time()
             args, kwargs = self._resolve_args(spec)
-            out = fn(*args, **kwargs)
+            if spec.trace_ctx is not None:
+                spec.trace_ctx["transfer_s"] = time.time() - _t
+            _t = time.time()
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                if spec.trace_ctx is not None:
+                    spec.trace_ctx["exec_s"] = time.time() - _t
             if spec.dynamic_returns:
                 return [self._expand_dynamic(spec, out)], None
             return self._split_returns(spec, out), None
@@ -426,18 +434,28 @@ class Worker:
             # Pooled worker: don't leak this task's env into the next.
             if restore is not None:
                 restore()
+            tracing.exit_task(trace_token)
             self.current_task_id = None
             self._running.pop(spec.task_id, None)
 
     def _run_actor_creation(self, spec: TaskSpec):
+        from ray_tpu import tracing
+
+        trace_token = tracing.enter_task(spec.trace_ctx)
         try:
             from ray_tpu.core.runtime_env import apply_runtime_env
 
             apply_runtime_env(spec.runtime_env)
             cls = serialization.unpack(spec.fn_blob)
+            _t = time.time()
             args, kwargs = self._resolve_args(spec)
+            if spec.trace_ctx is not None:
+                spec.trace_ctx["transfer_s"] = time.time() - _t
             execution_context.current_actor_id.set(spec.actor_id)
+            _t = time.time()
             instance = cls(*args, **kwargs)
+            if spec.trace_ctx is not None:
+                spec.trace_ctx["exec_s"] = time.time() - _t
             rt = ActorRuntime(spec.actor_id, instance, spec.max_concurrency,
                               spec.concurrency_groups)
             self.actors[spec.actor_id] = rt
@@ -445,16 +463,29 @@ class Worker:
         except Exception as e:
             err = TaskError(type(e).__name__, str(e), traceback.format_exc())
             return [err], err
+        finally:
+            tracing.exit_task(trace_token)
 
     def _run_actor_task(self, rt: ActorRuntime, spec: TaskSpec):
+        from ray_tpu import tracing
+
         self.current_task_id = spec.task_id
         self._running[spec.task_id] = ("thread", threading.get_ident())
         execution_context.current_actor_id.set(spec.actor_id)
         execution_context.current_task_id.set(spec.task_id)
+        trace_token = tracing.enter_task(spec.trace_ctx)
         try:
             method = getattr(rt.instance, spec.method_name)
+            _t = time.time()
             args, kwargs = self._resolve_args(spec)
-            out = method(*args, **kwargs)
+            if spec.trace_ctx is not None:
+                spec.trace_ctx["transfer_s"] = time.time() - _t
+            _t = time.time()
+            try:
+                out = method(*args, **kwargs)
+            finally:
+                if spec.trace_ctx is not None:
+                    spec.trace_ctx["exec_s"] = time.time() - _t
             return self._split_returns(spec, out), None
         except _Cancelled as e:
             err = TaskError("TaskCancelledError", str(e) or "cancelled", "")
@@ -463,6 +494,7 @@ class Worker:
             err = TaskError(type(e).__name__, str(e), traceback.format_exc())
             return [err] * max(1, spec.num_returns), err
         finally:
+            tracing.exit_task(trace_token)
             self.current_task_id = None
             self._running.pop(spec.task_id, None)
 
@@ -482,10 +514,20 @@ class Worker:
         done: _cf.Future = _cf.Future()
 
         async def runner():
+            from ray_tpu import tracing
+
             execution_context.current_actor_id.set(spec.actor_id)
             execution_context.current_task_id.set(spec.task_id)
+            # Each asyncio task runs in its own context copy, so this set
+            # is isolated from interleaved calls on the same loop.
+            tracing.enter_task(spec.trace_ctx)
             async with rt._asem:
-                return await method(*args, **kwargs)
+                _t = time.time()
+                try:
+                    return await method(*args, **kwargs)
+                finally:
+                    if spec.trace_ctx is not None:
+                        spec.trace_ctx["exec_s"] = time.time() - _t
 
         def schedule():
             t = loop.create_task(runner())

@@ -89,6 +89,14 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if self.path == "/api/timeline":
                 return self._json(state.timeline())
+            if parsed.path in ("/api/traces", "/api/traces/"):
+                return self._json(state.list_traces())
+            m = re.fullmatch(r"/api/traces/([0-9a-f]{32})", parsed.path)
+            if m:
+                tree = state.get_trace(m.group(1))
+                if tree is None:
+                    return self._json({"error": "trace not found"}, 404)
+                return self._json(tree)
             if self.path == "/api/events":
                 # Newest window, server-side (a post-mortem wants recent
                 # events; fetching the whole ring per poll would move 10x

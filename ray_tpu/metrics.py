@@ -1,8 +1,19 @@
 """User-facing metrics API (ref: python/ray/util/metrics.py).
 
-    from ray_tpu.metrics import Counter
-    c = Counter("requests_total", description="...", tag_keys=("route",))
+    from ray_tpu.metrics import Counter, Gauge, Histogram
+    c = Counter("requests_total", description="...", tag_keys=("route",),
+                default_tags={"app": "demo"})
     c.inc(1.0, tags={"route": "/gen"})
+
+Contract (parity with the reference util/metrics.py):
+
+- `tag_keys` declares the label set; `default_tags` pre-binds values for
+  any of them (and implicitly adds its keys), with call-site `tags`
+  overriding per observation.
+- `Counter.inc()` rejects negative values with ValueError — counters are
+  monotonic.
+- `Histogram` renders real Prometheus exposition (`_bucket` series with
+  cumulative `le` labels, `_sum`, `_count`) at the dashboard's /metrics.
 
 Values recorded in workers are flushed to the GCS automatically and served
 in Prometheus exposition format at the dashboard's /metrics endpoint.

@@ -15,22 +15,35 @@ the dashboard's `/metrics` endpoint read the aggregate back.
 
 from __future__ import annotations
 
+import collections
 import json
+import logging
 import threading
 import time
 from typing import Any
 
+logger = logging.getLogger(__name__)
+
 # ---------------------------------------------------------------- events
 
-_events: list[dict] = []
+_events: "collections.deque[dict]" = collections.deque()
 _events_lock = threading.Lock()
 MAX_BUFFER = 10_000
+# Overflow is a RING: the oldest event is evicted (and counted) so a
+# process with no flush loop — the driver — keeps its most recent spans
+# instead of freezing on the first 10k forever. _dropped_total is the
+# lifetime count; _dropped_reported is the share the worker flush loop has
+# already shipped to the GCS, so local readers can report only the
+# unshipped remainder without double counting.
+_dropped_total = 0
+_dropped_reported = 0
 
 
 def record_event(name: str, cat: str, start_s: float, dur_s: float,
                  pid: str = "driver", tid: str = "main",
                  args: dict | None = None) -> None:
     """Record one complete ("X") span. Timestamps: time.time() seconds."""
+    global _dropped_total
     ev = {
         "name": name, "cat": cat, "ph": "X",
         "ts": start_s * 1e6, "dur": dur_s * 1e6,
@@ -39,8 +52,12 @@ def record_event(name: str, cat: str, start_s: float, dur_s: float,
     if args:
         ev["args"] = args
     with _events_lock:
-        if len(_events) < MAX_BUFFER:
-            _events.append(ev)
+        _events.append(ev)
+        if len(_events) <= MAX_BUFFER:
+            return
+        _events.popleft()
+        _dropped_total += 1
+    _DROPPED_METRIC.inc(1.0)
 
 
 class span:
@@ -62,26 +79,120 @@ class span:
 
 def drain_events() -> list[dict]:
     with _events_lock:
-        out = _events[:]
+        out = list(_events)
         _events.clear()
     return out
 
 
+def peek_events() -> list[dict]:
+    """Non-destructive snapshot: trace/timeline readers must not consume
+    the buffer out from under each other (the flush loop drains)."""
+    with _events_lock:
+        return list(_events)
+
+
+def mark_dropped_reported(n: int) -> None:
+    """Commit `n` drops as shipped to the GCS — called AFTER the flush RPC
+    succeeds, so a failed flush retries the same count next tick."""
+    global _dropped_reported
+    with _events_lock:
+        _dropped_reported = min(_dropped_total, _dropped_reported + n)
+
+
+class ObsFlusher:
+    """One-batch-at-a-time shipper of this process's profile events to the
+    GCS with at-most-once delivery: each batch carries a per-source seq,
+    and a failed flush retries the SAME batch (same seq) next tick, so the
+    GCS can discard the duplicate after a timed-out-but-applied call.
+    Events keep accumulating in the ring while a batch retries (overflow
+    is counted); drops are marked reported only after the RPC succeeds."""
+
+    def __init__(self, source: str):
+        self.source = source
+        self.seq = 0
+        self.pending: dict | None = None
+
+    async def flush(self, call) -> None:
+        """`call(payload) -> awaitable` ships one batch; raises on failure
+        (the caller decides whether to log/ignore; state stays retryable)."""
+        if self.pending is None:
+            events = drain_events()
+            dropped = events_dropped_unreported()
+            if events or dropped:
+                self.seq += 1
+                self.pending = {"events": events, "dropped": dropped,
+                                "seq": self.seq}
+        if self.pending is None:
+            return
+        await call({"source": self.source, **self.pending})
+        mark_dropped_reported(self.pending["dropped"])
+        self.pending = None
+
+
+async def run_obs_flush_loop(source: str, gcs_call, interval_s: float,
+                             should_stop) -> None:
+    """The per-process observability flush loop, shared by workers
+    (core/worker.py) and drivers (core/client.py): every `interval_s`,
+    ship the profile-event batch (at-most-once via ObsFlusher) and the
+    metrics snapshot (idempotent last-snapshot-wins) to the GCS.
+    `gcs_call(method, payload)` -> awaitable; `should_stop()` -> bool."""
+    import asyncio
+
+    flusher = ObsFlusher(source)
+    while not should_stop():
+        await asyncio.sleep(interval_s)
+        try:
+            await flusher.flush(lambda p: gcs_call("profile_add", p))
+        except Exception:
+            pass  # batch kept; same seq retries next tick
+        try:
+            rows = metrics_snapshot()
+            if rows:
+                await gcs_call("metrics_push",
+                               {"source": source, "rows": rows})
+        except Exception:
+            pass
+
+
+def events_dropped_total() -> int:
+    """This process's lifetime drop count."""
+    with _events_lock:
+        return _dropped_total
+
+
+def events_dropped_unreported() -> int:
+    """Drops the GCS doesn't know about yet — the local share readers add
+    to the GCS tally without double counting flushed drops."""
+    with _events_lock:
+        return _dropped_total - _dropped_reported
+
+
 # ---------------------------------------------------------------- metrics
+
+# Shared latency histogram boundaries (seconds) for the serving path —
+# proxy, replica, and LLM histograms must stay bucket-comparable.
+LATENCY_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0)
+
 
 class _Metric:
     def __init__(self, name: str, description: str = "",
-                 tag_keys: tuple = ()):
+                 tag_keys: tuple = (), default_tags: dict | None = None):
         self.name = name
         self.description = description
-        self.tag_keys = tuple(tag_keys)
+        self.default_tags = dict(default_tags or {})
+        # default_tags introduce their keys implicitly (parity with the
+        # reference util/metrics.py: every series carries the defaults
+        # unless a call-site tag overrides them).
+        self.tag_keys = tuple(tag_keys) + tuple(
+            k for k in self.default_tags if k not in tag_keys)
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
         _registry[name] = self
 
     def _key(self, tags: dict | None) -> tuple:
-        tags = tags or {}
-        return tuple(str(tags.get(k, "")) for k in self.tag_keys)
+        merged = {**self.default_tags, **(tags or {})}
+        return tuple(str(merged.get(k, "")) for k in self.tag_keys)
 
     def snapshot(self) -> list[tuple[tuple, float]]:
         with self._lock:
@@ -94,6 +205,9 @@ class Counter(_Metric):
     kind = "counter"
 
     def inc(self, value: float = 1.0, tags: dict | None = None) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Counter.inc() requires a non-negative value, got {value}")
         k = self._key(tags)
         with self._lock:
             self._values[k] = self._values.get(k, 0.0) + value
@@ -114,8 +228,8 @@ class Histogram(_Metric):
 
     def __init__(self, name: str, description: str = "",
                  boundaries: tuple = (0.01, 0.1, 1, 10, 100),
-                 tag_keys: tuple = ()):
-        super().__init__(name, description, tag_keys)
+                 tag_keys: tuple = (), default_tags: dict | None = None):
+        super().__init__(name, description, tag_keys, default_tags)
         self.boundaries = tuple(boundaries)
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
@@ -142,11 +256,32 @@ class Histogram(_Metric):
 
 _registry: dict[str, _Metric] = {}
 
+# Satellite of the drop accounting above: created once per process (a
+# metric has no series until first inc, so idle processes export nothing).
+_DROPPED_METRIC = Counter(
+    "profile_events_dropped_total",
+    description="Profile events dropped at a full process buffer")
+
 
 def metrics_snapshot() -> list[dict]:
-    """Flushable view of this process's metrics."""
+    """Flushable view of this process's metrics. Histogram rows carry their
+    per-bucket counts + sum so the exposition side can render cumulative
+    `le` buckets instead of collapsing to an observation count."""
     out = []
     for m in list(_registry.values()):
+        if m.kind == "histogram":
+            counts, sums = m.snapshot_hist()
+            for key, buckets in counts.items():
+                out.append({
+                    "name": m.name, "kind": m.kind,
+                    "description": m.description,
+                    "tags": dict(zip(m.tag_keys, key)),
+                    "value": float(sum(buckets)),
+                    "buckets": list(buckets),
+                    "sum": sums.get(key, 0.0),
+                    "boundaries": list(m.boundaries),
+                })
+            continue
         for key, value in m.snapshot():
             out.append({
                 "name": m.name, "kind": m.kind, "description": m.description,
@@ -158,33 +293,81 @@ def metrics_snapshot() -> list[dict]:
 def prometheus_text(rows: list[dict]) -> str:
     """Render aggregated metric rows in Prometheus exposition format.
     Counter rows with identical (name, tags) are summed; gauges keep the
-    last value per source (caller pre-labels sources if needed)."""
-    agg: dict[tuple, float] = {}
+    last value per source (caller pre-labels sources if needed); histogram
+    rows merge bucket-wise into `_bucket`/`_sum`/`_count` series with
+    cumulative `le` labels."""
+    scalars: dict[tuple, float] = {}
+    hists: dict[tuple, dict] = {}
     meta: dict[str, tuple[str, str]] = {}
     for r in rows:
+        name = r["name"]
         tags = tuple(sorted(r.get("tags", {}).items()))
-        key = (r["name"], tags)
-        meta[r["name"]] = (r["kind"], r.get("description", ""))
-        if r["kind"] == "counter":
-            agg[key] = agg.get(key, 0.0) + r["value"]
+        key = (name, tags)
+        meta[name] = (r["kind"], r.get("description", ""))
+        if r["kind"] == "histogram" and r.get("buckets") is not None:
+            bounds = tuple(r.get("boundaries", ()))
+            h = hists.setdefault(key, {
+                "boundaries": bounds,
+                "buckets": [0] * (len(bounds) + 1), "sum": 0.0,
+            })
+            if (h["boundaries"] == bounds
+                    and len(h["buckets"]) == len(r["buckets"])):
+                h["buckets"] = [a + b for a, b in zip(h["buckets"],
+                                                      r["buckets"])]
+                h["sum"] += float(r.get("sum", 0.0))
+            else:
+                # Same metric name flushed with different boundaries (a
+                # definition conflict across processes): the row can't be
+                # merged bucket-wise — say so instead of losing it silently.
+                logger.warning(
+                    "histogram %s: boundary mismatch across sources "
+                    "(%s vs %s); dropping a conflicting row from exposition",
+                    name, h["boundaries"], bounds)
+        elif r["kind"] == "counter":
+            scalars[key] = scalars.get(key, 0.0) + r["value"]
         else:
-            agg[key] = r["value"]
-    lines = []
-    seen_names = set()
-    for (name, tags), value in sorted(agg.items()):
-        if name not in seen_names:
-            kind, desc = meta[name]
-            if desc:
-                lines.append(f"# HELP {name} {desc}")
-            lines.append(f"# TYPE {name} {kind if kind != 'histogram' else 'gauge'}")
-            seen_names.add(name)
-        label = ",".join(f'{k}="{v}"' for k, v in tags)
+            scalars[key] = r["value"]
+
+    lines: list[str] = []
+    emitted: set[str] = set()
+
+    def labels(tags, extra=()) -> str:
+        return ",".join(f'{k}="{v}"' for k, v in (*tags, *extra))
+
+    def emit_meta(name: str) -> None:
+        if name in emitted:
+            return
+        kind, desc = meta[name]
+        if desc:
+            lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {kind}")
+        emitted.add(name)
+
+    def sample(name: str, tags, value, extra=()) -> None:
+        label = labels(tags, extra)
         lines.append(f"{name}{{{label}}} {value}" if label
                      else f"{name} {value}")
+
+    for (name, tags), value in sorted(scalars.items()):
+        emit_meta(name)
+        sample(name, tags, value)
+    for (name, tags), h in sorted(hists.items()):
+        emit_meta(name)
+        cum = 0
+        for bound, count in zip(h["boundaries"], h["buckets"][:-1]):
+            cum += count
+            sample(f"{name}_bucket", tags, cum, extra=(("le", bound),))
+        cum += h["buckets"][-1]
+        sample(f"{name}_bucket", tags, cum, extra=(("le", "+Inf"),))
+        sample(f"{name}_sum", tags, h["sum"])
+        sample(f"{name}_count", tags, cum)
     return "\n".join(lines) + "\n"
 
 
 # ---------------------------------------------------------------- timeline
 
-def chrome_trace(events: list[dict]) -> str:
-    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+def chrome_trace(events: list[dict], metadata: dict | None = None) -> str:
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if metadata:
+        doc["metadata"] = metadata
+    return json.dumps(doc)

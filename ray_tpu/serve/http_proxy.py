@@ -23,16 +23,34 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
+
+from ray_tpu import profiling, tracing
 
 _REASONS = {
     200: b"OK", 400: b"Bad Request", 404: b"Not Found",
     413: b"Payload Too Large", 500: b"Internal Server Error",
     501: b"Not Implemented", 503: b"Service Unavailable",
 }
+
+# Per-request Serve latency breakdown, flushed to the GCS by the hosting
+# worker's observability loop and exposed at the dashboard's /metrics.
+_REQS_TOTAL = profiling.Counter(
+    "serve_requests_total", description="Ingress HTTP requests",
+    tag_keys=("route", "status"))
+_REQ_LATENCY = profiling.Histogram(
+    "serve_request_latency_s",
+    description="Ingress end-to-end request latency",
+    boundaries=profiling.LATENCY_BUCKETS_S, tag_keys=("route",))
+_QUEUE_WAIT = profiling.Histogram(
+    "serve_queue_wait_s",
+    description="Ingress queue wait: request admission to replica dispatch",
+    boundaries=profiling.LATENCY_BUCKETS_S, tag_keys=("route",))
 
 
 def _decode_payload(command: str, parsed, headers: dict, body: bytes):
@@ -267,41 +285,85 @@ class HTTPProxy(_RouterMixin):
         await writer.drain()
 
     async def _respond(self, command, path, headers, body, writer) -> bool:
-        """Handle one request; returns True if the connection must close."""
+        """Handle one request; returns True if the connection must close.
+
+        Every request runs under a root trace span (child of an incoming
+        `traceparent` header when present). The context is ambient for the
+        dispatch below, so the replica actor call — and anything it fans
+        out to — joins the same trace; responses echo the trace id in
+        `traceparent` / `x-ray-tpu-trace-id` headers."""
         parsed = urlparse(path)
+        t_start = time.time()
+        ctx = tracing.start_http_context(headers.get("traceparent"))
+        token = tracing.set_current(ctx)
+        trace_headers = (
+            (b"traceparent", tracing.format_traceparent(ctx).encode()),
+            (b"x-ray-tpu-trace-id", ctx.trace_id.encode()),
+        )
         name = self._match(parsed.path)
-        if name is None:
-            await self._send(writer, 404, b'{"error": "no route"}')
-            return False
-        payload, wants_stream = _decode_payload(
-            command, parsed, headers, body)
-        if self._inflight >= self._max_inflight:
-            # Admission control: surface overload instead of queueing
-            # unboundedly (ref: http_proxy request backpressure).
-            await self._send(writer, 503, b'{"error": "overloaded"}',
-                             extra=((b"Retry-After", b"1"),))
-            return False
-        self._inflight += 1
+        # Metrics label = matched deployment only: unmatched paths collapse
+        # into one sentinel series, so a URL scanner can't mint unbounded
+        # per-path label cardinality (the request path stays visible in the
+        # span name below).
+        route = name or "__unmatched__"
+        # Baggage rides the carrier into every downstream hop: replicas /
+        # the LLM engine tag their metrics by the ingress route.
+        ctx.baggage.setdefault("route", name or parsed.path)
+        status = 500
         try:
-            handle = self._handle(name)
-            if wants_stream and isinstance(payload, dict):
-                return await self._stream_sse(name, handle, payload, writer)
-            ref = await self._submit(name, handle, payload)
-            result = await self._await_ref(ref)
-            await self._send(
-                writer, 200, json.dumps({"result": result}).encode())
-            return False
-        except (ConnectionResetError, BrokenPipeError):
-            return True
-        except Exception as e:  # noqa: BLE001
+            if name is None:
+                status = 404
+                await self._send(writer, 404, b'{"error": "no route"}',
+                                 extra=trace_headers)
+                return False
+            payload, wants_stream = _decode_payload(
+                command, parsed, headers, body)
+            if self._inflight >= self._max_inflight:
+                # Admission control: surface overload instead of queueing
+                # unboundedly (ref: http_proxy request backpressure).
+                status = 503
+                await self._send(writer, 503, b'{"error": "overloaded"}',
+                                 extra=((b"Retry-After", b"1"),)
+                                 + trace_headers)
+                return False
+            self._inflight += 1
             try:
+                handle = self._handle(name)
+                if wants_stream and isinstance(payload, dict):
+                    status = 200
+                    return await self._stream_sse(
+                        name, handle, payload, writer, trace_headers)
+                ref = await self._submit(name, handle, payload)
+                result = await self._await_ref(ref)
+                status = 200
                 await self._send(
-                    writer, 500, json.dumps({"error": str(e)}).encode())
-            except Exception:
+                    writer, 200, json.dumps({"result": result}).encode(),
+                    extra=trace_headers)
+                return False
+            except (ConnectionResetError, BrokenPipeError):
+                status = 499
                 return True
-            return False
+            except Exception as e:  # noqa: BLE001
+                status = 500
+                try:
+                    await self._send(
+                        writer, 500, json.dumps({"error": str(e)}).encode(),
+                        extra=trace_headers)
+                except Exception:
+                    return True
+                return False
+            finally:
+                self._inflight -= 1
         finally:
-            self._inflight -= 1
+            tracing.reset_current(token)
+            dur = time.time() - t_start
+            _REQS_TOTAL.inc(1.0, tags={"route": route, "status": str(status)})
+            _REQ_LATENCY.observe(dur, tags={"route": route})
+            profiling.record_event(
+                f"HTTP {command} {parsed.path}", "serve", t_start, dur,
+                pid=f"serve:{os.getpid()}", tid="proxy",
+                args=tracing.span_event_args(ctx, route=route,
+                                             status=status))
 
     async def _pick(self, name: str, handle):
         """Pick a replica for one request.
@@ -310,7 +372,12 @@ class HTTPProxy(_RouterMixin):
         nothing blocks. Slow path (stale cache, no replicas, cold start):
         runs on the dispatch pool under a per-deployment single-flight
         lock, so one cold deployment occupies ONE pool thread while
-        requests to warm deployments keep flowing."""
+        requests to warm deployments keep flowing.
+
+        The pick duration IS the request's queue wait (route refresh, cold
+        start, replica selection) — observed here, once, for every path
+        that dispatches."""
+        t0 = time.time()
         replica = handle.try_pick_replica()
         if replica is None:
             lock = self._dep_locks.setdefault(name, asyncio.Lock())
@@ -320,6 +387,7 @@ class HTTPProxy(_RouterMixin):
                     loop = asyncio.get_running_loop()
                     replica = await loop.run_in_executor(
                         self._pool, handle._pick_replica)
+        _QUEUE_WAIT.observe(time.time() - t0, tags={"route": name})
         return replica
 
     async def _submit(self, name: str, handle, payload):
@@ -343,7 +411,8 @@ class HTTPProxy(_RouterMixin):
                 lambda: ray_tpu.get(ref, timeout=self._timeout))
         return val
 
-    async def _stream_sse(self, name, handle, payload, writer) -> bool:
+    async def _stream_sse(self, name, handle, payload, writer,
+                          trace_headers: tuple = ()) -> bool:
         """Server-sent events: tokens flush as the replica produces them.
         The stream is pinned to one replica (cursor state lives there);
         every poll wait is thread-free. Body is EOF-terminated
@@ -355,10 +424,13 @@ class HTTPProxy(_RouterMixin):
             return handle.dispatch(replica, method, args, {})
 
         sid = await self._await_ref(_call("submit_stream", payload))
-        writer.write(b"HTTP/1.1 200 OK\r\n"
-                     b"Content-Type: text/event-stream\r\n"
-                     b"Cache-Control: no-cache\r\n"
-                     b"Connection: close\r\n\r\n")
+        head = (b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/event-stream\r\n"
+                b"Cache-Control: no-cache\r\n"
+                b"Connection: close\r\n")
+        for k, v in trace_headers:
+            head += k + b": " + v + b"\r\n"
+        writer.write(head + b"\r\n")
         await writer.drain()
         try:
             cursor = 0

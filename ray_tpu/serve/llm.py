@@ -30,6 +30,52 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ray_tpu import profiling as _profiling
+
+# Per-request serving histograms, tagged by the ingress route (from trace
+# baggage) and the replica actor serving the request; flushed to the GCS
+# with the hosting worker's metrics and exposed at the dashboard /metrics.
+_TTFT_HIST = _profiling.Histogram(
+    "serve_llm_ttft_s",
+    description="LLM time-to-first-token (queue wait + prefill)",
+    boundaries=_profiling.LATENCY_BUCKETS_S,
+    tag_keys=("route", "replica"))
+_DECODE_HIST = _profiling.Histogram(
+    "serve_llm_decode_tok_s",
+    description="LLM per-request decode throughput (tokens/s after TTFT)",
+    boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500),
+    tag_keys=("route", "replica"))
+
+
+def _request_metric_tags() -> dict:
+    """Route (ingress baggage) + replica (runtime context) tags for the
+    per-request histograms. Safe anywhere: falls back to empty/local."""
+    from ray_tpu import tracing
+
+    ctx = tracing.get_current()
+    route = (ctx.baggage.get("route", "") if ctx is not None else "") or ""
+    replica = "local"
+    try:
+        from ray_tpu import api as _api
+
+        aid = _api.get_runtime_context().get_actor_id()
+        if aid:
+            replica = aid[:8]
+    except Exception:
+        pass
+    return {"route": route, "replica": replica}
+
+
+def _observe_request_metrics(req: "GenRequest", tags: dict) -> None:
+    if req.first_token_at is not None:
+        _TTFT_HIST.observe(req.first_token_at - req.submitted_at, tags=tags)
+    if (req.finished_at is not None and req.first_token_at is not None
+            and len(req.out_ids) > 1):
+        decode_s = req.finished_at - req.first_token_at
+        if decode_s > 0:
+            _DECODE_HIST.observe((len(req.out_ids) - 1) / decode_s,
+                                 tags=tags)
+
 
 @dataclasses.dataclass
 class GenRequest:
@@ -657,10 +703,12 @@ class LLMDeployment:
 
     def generate(self, prompt_ids: list[int], max_tokens: int = 64,
                  temperature: float = 0.0, eos_id: int | None = None) -> dict:
+        tags = _request_metric_tags()
         req = self.engine.submit(
             prompt_ids, max_tokens=max_tokens, temperature=temperature,
             eos_id=eos_id)
         req.done.wait()
+        _observe_request_metrics(req, tags)
         if req.error:
             raise RuntimeError(req.error)
         return {
@@ -713,6 +761,7 @@ class LLMDeployment:
             out["error"] = req.error
         if done:
             self._streams.pop(request_id, None)
+            _observe_request_metrics(req, _request_metric_tags())
             out["truncated"] = req.truncated
             if req.first_token_at is not None:
                 out["ttft_s"] = req.first_token_at - req.submitted_at

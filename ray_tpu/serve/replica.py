@@ -11,7 +11,14 @@ import threading
 import time
 from typing import Any
 
+from ray_tpu import profiling, tracing
 from ray_tpu.core import serialization
+
+_EXEC_LATENCY = profiling.Histogram(
+    "serve_replica_execute_s",
+    description="Replica user-code execution time per request",
+    boundaries=profiling.LATENCY_BUCKETS_S,
+    tag_keys=("deployment",))
 
 
 class Replica:
@@ -103,13 +110,22 @@ class Replica:
                     "idle_s": idle}
 
     def handle_request(self, method: str, args: tuple, kwargs: dict):
+        dep = getattr(self, "_deployment_name", None) or type(
+            self.callable).__name__
         with self._lock:
             self._inflight += 1
+        t0 = time.time()
         try:
-            if method == "__call__":
-                return self.callable(*args, **kwargs)
-            return getattr(self.callable, method)(*args, **kwargs)
+            # Child span of the proxy's request span (the actor-task hop
+            # restored the ambient context): user-code execution, separated
+            # from the dispatch/queue time the outer spans carry.
+            with tracing.start_span(f"replica:{dep}.{method}", cat="serve"):
+                if method == "__call__":
+                    return self.callable(*args, **kwargs)
+                return getattr(self.callable, method)(*args, **kwargs)
         finally:
+            _EXEC_LATENCY.observe(time.time() - t0,
+                                  tags={"deployment": dep})
             with self._lock:
                 self._inflight -= 1
                 self._processed += 1

@@ -77,12 +77,30 @@ def list_cluster_events(after_seq: int = 0,
     return resp["events"]
 
 
+def _profile_events() -> tuple[list[dict], int]:
+    """All profile events visible from this process (GCS aggregate + the
+    local, NOT-drained buffer) plus the cluster-wide drop count."""
+    from ray_tpu import profiling
+
+    resp = _call_gcs("profile_get")
+    if isinstance(resp, dict):
+        events, dropped = list(resp.get("events") or []), int(
+            resp.get("dropped", 0))
+    else:  # pre-drop-count GCS payload shape
+        events, dropped = list(resp or []), 0
+    # Unreported share only: a worker-hosted reader must not re-count
+    # drops its flush loop already shipped into the GCS tally.
+    return (events + profiling.peek_events(),
+            dropped + profiling.events_dropped_unreported())
+
+
 def list_tasks(limit: int = 200) -> list[dict]:
     """Recent task executions aggregated from worker profile spans
     (ref: dashboard/state_aggregator.py task rows + StatsGcsService
     AddProfileData). Newest first: name, kind, node, worker, start,
     duration."""
-    events = _call_gcs("profile_get") or []
+    resp = _call_gcs("profile_get")
+    events = (resp.get("events") if isinstance(resp, dict) else resp) or []
     rows = []
     for ev in events:
         rows.append({
@@ -143,25 +161,61 @@ def object_store_stats() -> list[dict]:
 def timeline(filename: str | None = None):
     """Chrome-trace JSON of task/actor execution spans collected from all
     workers (ref: `_private/state.py:829` ray.timeline). Open in
-    chrome://tracing or Perfetto. Returns the event list; writes the trace
-    to `filename` when given."""
-    from ray_tpu import profiling
+    chrome://tracing or Perfetto. Returns the event list — including
+    synthesized flow arrows (`ph: "s"/"f"`) connecting traced parent→child
+    spans across pids; writes the trace (with an `events_dropped` metadata
+    count) to `filename` when given."""
+    from ray_tpu import profiling, tracing
 
-    events = list(_call_gcs("profile_get")) + profiling.drain_events()
+    events, dropped = _profile_events()
+    events = events + tracing.flow_events(events)
     if filename:
         with open(filename, "w") as f:
-            f.write(profiling.chrome_trace(events))
+            f.write(profiling.chrome_trace(
+                events, metadata={"profile_events_dropped": dropped}))
     return events
 
 
-def metrics_rows() -> list[dict]:
-    """Aggregated metric rows from every reporting process."""
+def timeline_metadata() -> dict:
+    """The metadata block timeline(filename) embeds, for direct pollers —
+    tally-only RPC, so it never moves the full event table."""
     from ray_tpu import profiling
 
-    rows = list(_call_gcs("metrics_get"))
-    rows += [{**r, "tags": {**r["tags"], "source": "driver"}}
-             for r in profiling.metrics_snapshot()]
-    return rows
+    stats = _call_gcs("profile_stats") or {}
+    return {"profile_events_dropped":
+            int(stats.get("dropped", 0))
+            + profiling.events_dropped_unreported()}
+
+
+def list_traces() -> list[dict]:
+    """One row per distributed trace (newest first): trace_id, span count,
+    root span name, start, end-to-end duration (tracing.py). Grouped
+    server-side over the FLUSHED spans — every process (drivers included)
+    ships its buffer on a ~1s cadence, so rows lag live spans by at most
+    one flush tick but the event table never moves over the wire."""
+    return list(_call_gcs("profile_traces") or [])
+
+
+def get_trace(trace_id: str) -> dict | None:
+    """Reconstructed span tree for one trace_id: per-span pid/tid, start +
+    duration, and the queue-wait / transfer / execute breakdown each hop
+    recorded. None if no span of that trace has been flushed yet.
+    Filtered server-side — polling this endpoint must not move the whole
+    profile-event table per call."""
+    from ray_tpu import profiling, tracing
+
+    resp = _call_gcs("profile_get", {"trace_id": trace_id})
+    events = (resp.get("events") if isinstance(resp, dict) else resp) or []
+    return tracing.build_trace_tree(
+        list(events) + profiling.peek_events(), trace_id)
+
+
+def metrics_rows() -> list[dict]:
+    """Aggregated metric rows from every reporting process. Every process
+    with a client — drivers included — pushes its snapshot to the GCS on
+    the flush cadence, so the hub view IS the complete view (appending the
+    local snapshot here would double-count this process's counters)."""
+    return list(_call_gcs("metrics_get"))
 
 
 def prometheus_metrics() -> str:

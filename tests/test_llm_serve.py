@@ -362,6 +362,20 @@ class TestServeIntegration:
             assert all(o["ttft_s"] > 0 for o in outs)
             m = ray_tpu.get(handle.method("metrics"), timeout=60)
             assert m["completed"] >= 6
+            # Per-request TTFT/decode histograms flush from the replica's
+            # worker to the cluster metrics hub in histogram exposition.
+            from ray_tpu import state as _state
+
+            deadline = time.time() + 30
+            text = ""
+            while time.time() < deadline:
+                text = _state.prometheus_metrics()
+                if "serve_llm_ttft_s_bucket" in text:
+                    break
+                time.sleep(0.5)
+            assert "serve_llm_ttft_s_bucket" in text
+            assert "serve_llm_ttft_s_count" in text
+            assert "serve_llm_decode_tok_s_bucket" in text
         finally:
             serve.shutdown()
             ray_tpu.shutdown()

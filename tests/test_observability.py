@@ -48,6 +48,64 @@ class TestMetricsUnit:
         assert 'x_total{s="w1"} 5.0' in text
         assert "# TYPE x_total counter" in text
 
+    def test_histogram_exposition_format(self):
+        """Pin the Prometheus text-format contract for histograms:
+        `_bucket` series with CUMULATIVE `le` labels (+Inf included),
+        `_sum`, `_count`, and `# TYPE ... histogram`."""
+        h = Histogram("pin_lat_s", description="pinned",
+                      boundaries=(1, 10), tag_keys=("route",))
+        h.observe(0.5, tags={"route": "/a"})
+        h.observe(5.0, tags={"route": "/a"})
+        h.observe(100.0, tags={"route": "/a"})
+        text = profiling.prometheus_text(profiling.metrics_snapshot())
+        assert "# TYPE pin_lat_s histogram" in text
+        assert 'pin_lat_s_bucket{route="/a",le="1"} 1' in text
+        assert 'pin_lat_s_bucket{route="/a",le="10"} 2' in text
+        assert 'pin_lat_s_bucket{route="/a",le="+Inf"} 3' in text
+        assert 'pin_lat_s_sum{route="/a"} 105.5' in text
+        assert 'pin_lat_s_count{route="/a"} 3' in text
+
+    def test_histogram_rows_merge_across_sources(self):
+        """Same histogram flushed by two processes merges bucket-wise."""
+        row = {"name": "m_lat_s", "kind": "histogram", "tags": {},
+               "value": 2.0, "buckets": [1, 1, 0], "sum": 3.0,
+               "boundaries": [1, 10]}
+        text = profiling.prometheus_text([row, dict(row)])
+        assert 'm_lat_s_bucket{le="1"} 2' in text
+        assert 'm_lat_s_bucket{le="10"} 4' in text
+        assert 'm_lat_s_bucket{le="+Inf"} 4' in text
+        assert "m_lat_s_sum 6.0" in text
+        assert "m_lat_s_count 4" in text
+
+    def test_default_tags_and_negative_inc_rejected(self):
+        c = Counter("t_dflt_total", tag_keys=("route",),
+                    default_tags={"app": "obs"})
+        c.inc(2.0, tags={"route": "/x"})
+        c.inc(1.0, tags={"route": "/x", "app": "override"})
+        rows = {tuple(sorted(r["tags"].items())): r["value"]
+                for r in profiling.metrics_snapshot()
+                if r["name"] == "t_dflt_total"}
+        assert rows[(("app", "obs"), ("route", "/x"))] == 2.0
+        assert rows[(("app", "override"), ("route", "/x"))] == 1.0
+        with pytest.raises(ValueError):
+            c.inc(-1.0)
+
+    def test_buffer_overflow_counted_not_silent(self, monkeypatch):
+        """Events past MAX_BUFFER increment the drop counters (satellite:
+        no silent vanishing) and the profile_events_dropped_total metric."""
+        base_total = profiling.events_dropped_total()
+        with profiling._events_lock:
+            free = profiling.MAX_BUFFER - len(profiling._events)
+        monkeypatch.setattr(profiling, "MAX_BUFFER",
+                            profiling.MAX_BUFFER - free + 1)
+        profiling.record_event("fits", "t", 0.0, 0.001)
+        profiling.record_event("dropped1", "t", 0.0, 0.001)
+        profiling.record_event("dropped2", "t", 0.0, 0.001)
+        assert profiling.events_dropped_total() == base_total + 2
+        rows = [r for r in profiling.metrics_snapshot()
+                if r["name"] == "profile_events_dropped_total"]
+        assert rows and rows[0]["value"] >= 2
+
 
 class TestTimeline:
     def test_task_spans_reach_timeline(self, cluster, tmp_path):
@@ -93,6 +151,21 @@ class TestTimeline:
                 break
             time.sleep(0.5)
         assert "app_things_total" in text, text
+
+    def test_timeline_metadata_reports_drop_count(self, cluster, tmp_path,
+                                                  monkeypatch):
+        """The written chrome trace carries the cluster-wide dropped-event
+        count so a truncated timeline is visibly truncated."""
+        with profiling._events_lock:
+            free = profiling.MAX_BUFFER - len(profiling._events)
+        monkeypatch.setattr(profiling, "MAX_BUFFER",
+                            profiling.MAX_BUFFER - free)
+        profiling.record_event("doomed", "t", 0.0, 0.001)  # buffer is full
+        out = str(tmp_path / "trace_md.json")
+        state.timeline(out)
+        doc = json.load(open(out))
+        assert doc["metadata"]["profile_events_dropped"] >= 1
+        assert state.timeline_metadata()["profile_events_dropped"] >= 1
 
     def test_dashboard_metrics_endpoint(self, cluster):
         from ray_tpu.dashboard import start_dashboard

@@ -1,0 +1,292 @@
+"""End-to-end distributed tracing: causal context across tasks, actors,
+and Serve requests (tracing.py).
+
+One trace_id follows a request through every cross-process hop; spans ride
+the existing profiling buffer -> GCS flush path and reconstruct into a
+span tree via state.get_trace() / the dashboard's /api/traces.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve, state, tracing
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+class TestTraceContextUnit:
+    def test_span_nesting_and_capture(self):
+        assert tracing.get_current() is None
+        with tracing.start_span("outer") as outer:
+            assert tracing.get_current() is outer
+            assert outer.parent_span_id is None
+            with tracing.start_span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_span_id == outer.span_id
+                carrier = tracing.capture_for_submission()
+                assert carrier["trace_id"] == outer.trace_id
+                assert carrier["parent_span_id"] == inner.span_id
+                assert carrier["span_id"] != inner.span_id
+            assert tracing.get_current() is outer
+        assert tracing.get_current() is None
+        # outside any span, submissions are untraced
+        assert tracing.capture_for_submission() is None
+
+    def test_traceparent_roundtrip(self):
+        ctx = tracing.TraceContext(tracing.new_trace_id(),
+                                   tracing.new_span_id())
+        header = tracing.format_traceparent(ctx)
+        assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+        back = tracing.parse_traceparent(header)
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+
+    def test_traceparent_rejects_malformed(self):
+        for bad in (None, "", "garbage", "00-zz-yy-01",
+                    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # null trace
+                    "00-" + "a" * 31 + "-" + "1" * 16 + "-01",   # short id
+                    # int(x, 16)-parseable but not hex charset — would break
+                    # the dashboard's [0-9a-f]{32} trace route if admitted
+                    "00-+" + "a" * 31 + "-" + "1" * 16 + "-01"):
+            assert tracing.parse_traceparent(bad) is None
+        # lenient in, canonical out: uppercase hex is lowercased
+        up = tracing.parse_traceparent(
+            "00-" + "A" * 32 + "-" + "B" * 16 + "-01")
+        assert up.trace_id == "a" * 32 and up.span_id == "b" * 16
+
+    def test_baggage_flows_to_children(self):
+        with tracing.start_span("root", baggage={"route": "/x"}):
+            with tracing.start_span("child") as child:
+                assert child.baggage["route"] == "/x"
+            carrier = tracing.capture_for_submission()
+            assert carrier["baggage"]["route"] == "/x"
+            restored = tracing.context_from_carrier(carrier)
+            assert restored.baggage["route"] == "/x"
+
+
+class TestTaskChainTracing:
+    def test_one_trace_spans_driver_task_nested_task_actor(self, cluster):
+        """driver -> task -> nested task -> actor call: one trace_id, and
+        get_trace() reconstructs the parent/child chain across workers."""
+
+        @ray_tpu.remote
+        def child():
+            ctx = tracing.get_current()
+            return ctx.trace_id if ctx else None
+
+        @ray_tpu.remote
+        def parent_task():
+            ctx = tracing.get_current()
+            nested = ray_tpu.get(child.remote())
+            return (ctx.trace_id if ctx else None, nested)
+
+        @ray_tpu.remote
+        class Probe:
+            def m(self):
+                ctx = tracing.get_current()
+                return ctx.trace_id if ctx else None
+
+        with tracing.start_span("chain-root") as root:
+            t_outer, t_nested = ray_tpu.get(parent_task.remote(), timeout=60)
+            probe = Probe.remote()
+            t_actor = ray_tpu.get(probe.m.remote(), timeout=60)
+        assert t_outer == t_nested == t_actor == root.trace_id
+
+        # Spans flush from each worker on a ~1s cadence; poll until every
+        # expected hop has landed (a span-count threshold can be satisfied
+        # before the slowest worker's flush tick).
+        expected = {"chain-root", "parent_task", "child", "m"}
+        deadline = time.monotonic() + 30
+        tree, by_name = None, {}
+
+        def collect(node):
+            by_name[node["name"]] = node
+            for c in node["children"]:
+                collect(c)
+
+        while time.monotonic() < deadline:
+            tree = state.get_trace(root.trace_id)
+            by_name = {}
+            if tree:
+                for r in tree["spans"]:
+                    collect(r)
+                if expected <= set(by_name):
+                    break
+            time.sleep(0.5)
+        assert tree and tree["num_spans"] >= 4, tree
+        assert expected <= set(by_name), set(by_name)
+        root_node = by_name["chain-root"]
+        assert root_node["parent_span_id"] is None
+        assert by_name["parent_task"]["parent_span_id"] == root_node["span_id"]
+        assert (by_name["child"]["parent_span_id"]
+                == by_name["parent_task"]["span_id"])
+        assert by_name["m"]["parent_span_id"] == root_node["span_id"]
+        # per-hop breakdown recorded by the executing worker
+        for hop in ("parent_task", "child", "m"):
+            assert by_name[hop]["queue_wait_s"] >= 0
+            assert "exec_s" in by_name[hop]
+
+    def test_get_trace_unknown_id_is_none(self, cluster):
+        assert state.get_trace("f" * 32) is None
+
+
+def _post(url, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+class TestServeRequestTracing:
+    @pytest.fixture(scope="class")
+    def traced_app(self, cluster):
+        @ray_tpu.remote
+        def traced_fanout(x):
+            return x * 2
+
+        @serve.deployment(name="traced_fan", route_prefix="/traced_fan")
+        class Fan:
+            def __call__(self, payload):
+                return {"y": ray_tpu.get(
+                    traced_fanout.remote(payload.get("x", 1)))}
+
+        serve.run(Fan.bind())
+        _proxy, port = serve.start_proxy()
+        # wait until the proxy routes the deployment
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                _post(f"http://127.0.0.1:{port}/traced_fan", {"x": 0})
+                break
+            except Exception:
+                time.sleep(0.5)
+        return port
+
+    def test_traceparent_roundtrip_and_span_tree(self, traced_app):
+        """A Serve HTTP request with an incoming traceparent yields >=4
+        causally-linked spans sharing the caller's trace_id across >=3
+        processes (proxy, replica worker, fan-out worker)."""
+        port = traced_app
+        trace_id = tracing.new_trace_id()
+        parent_span = tracing.new_span_id()
+        resp = _post(f"http://127.0.0.1:{port}/traced_fan", {"x": 21},
+                     headers={"traceparent":
+                              f"00-{trace_id}-{parent_span}-01"})
+        assert json.loads(resp.read()) == {"result": {"y": 42}}
+        # trace id honored and echoed in the response headers
+        assert resp.headers["x-ray-tpu-trace-id"] == trace_id
+        echoed = tracing.parse_traceparent(resp.headers["traceparent"])
+        assert echoed.trace_id == trace_id
+
+        deadline = time.monotonic() + 30
+        tree = None
+        while time.monotonic() < deadline:
+            tree = state.get_trace(trace_id)
+            if tree and tree["num_spans"] >= 4:
+                break
+            time.sleep(0.5)
+        assert tree and tree["num_spans"] >= 4, tree
+
+        nodes = []
+
+        def collect(node):
+            nodes.append(node)
+            for c in node["children"]:
+                collect(c)
+
+        for r in tree["spans"]:
+            collect(r)
+        names = {n["name"] for n in nodes}
+        assert any(n.startswith("HTTP POST") for n in names), names
+        assert "handle_request" in names
+        assert "traced_fanout" in names
+        # >=3 distinct processes: the proxy, the replica's worker, and the
+        # fan-out task's worker all have distinct (pid, tid) lanes.
+        lanes = {(n["pid"], n["tid"]) for n in nodes}
+        assert len(lanes) >= 3, lanes
+        # the proxy root span is the child of the client's traceparent
+        http_root = next(n for n in nodes if n["name"].startswith("HTTP"))
+        assert http_root["parent_span_id"] == parent_span
+
+    def test_timeline_gains_flow_events(self, traced_app):
+        port = traced_app
+        trace_id = tracing.new_trace_id()
+        _post(f"http://127.0.0.1:{port}/traced_fan", {"x": 2},
+              headers={"traceparent":
+                       f"00-{trace_id}-{tracing.new_span_id()}-01"}).read()
+        deadline = time.monotonic() + 30
+        flows = []
+        while time.monotonic() < deadline:
+            events = ray_tpu.timeline()
+            flows = [e for e in events if e.get("ph") in ("s", "f")
+                     and str(e.get("id", "")).startswith(trace_id[:8])]
+            if any(e["ph"] == "s" for e in flows) and any(
+                    e["ph"] == "f" for e in flows):
+                break
+            time.sleep(0.5)
+        assert any(e["ph"] == "s" for e in flows), flows[:4]
+        assert any(e["ph"] == "f" for e in flows), flows[:4]
+
+    def test_dashboard_traces_api_and_metrics(self, traced_app):
+        from ray_tpu.dashboard import start_dashboard
+
+        port = traced_app
+        trace_id = tracing.new_trace_id()
+        _post(f"http://127.0.0.1:{port}/traced_fan", {"x": 3},
+              headers={"traceparent":
+                       f"00-{trace_id}-{tracing.new_span_id()}-01"}).read()
+
+        dash = start_dashboard(port=0)
+        try:
+            deadline = time.monotonic() + 30
+            tree = None
+            while time.monotonic() < deadline:
+                rows = json.loads(urllib.request.urlopen(
+                    dash.url + "/api/traces", timeout=30).read())
+                if any(r["trace_id"] == trace_id and r["num_spans"] >= 4
+                       for r in rows):
+                    tree = json.loads(urllib.request.urlopen(
+                        dash.url + f"/api/traces/{trace_id}",
+                        timeout=30).read())
+                    break
+                time.sleep(0.5)
+            assert tree is not None and tree["trace_id"] == trace_id
+            assert tree["num_spans"] >= 4
+
+            # unknown trace -> 404
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    dash.url + "/api/traces/" + "f" * 32, timeout=30)
+            assert err.value.code == 404
+
+            # Serve latency breakdown histograms reach /metrics in proper
+            # histogram exposition (cumulative le buckets, _sum, _count).
+            deadline = time.monotonic() + 30
+            text = ""
+            while time.monotonic() < deadline:
+                text = urllib.request.urlopen(
+                    dash.url + "/metrics", timeout=30).read().decode()
+                if ("serve_request_latency_s_bucket" in text
+                        and "serve_queue_wait_s_bucket" in text
+                        and "serve_replica_execute_s_bucket" in text):
+                    break
+                time.sleep(0.5)
+            assert "# TYPE serve_request_latency_s histogram" in text
+            assert 'le="+Inf"' in text
+            assert "serve_request_latency_s_sum" in text
+            assert "serve_request_latency_s_count" in text
+            assert "serve_queue_wait_s_bucket" in text, text[:2000]
+            assert "serve_replica_execute_s_bucket" in text
+        finally:
+            dash.stop()
