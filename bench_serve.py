@@ -41,6 +41,12 @@ def main() -> None:
     ap.add_argument("--page-size", type=int, default=64)
     ap.add_argument("--n-pages", type=int, default=None,
                     help="KV pool pages (default: half the dense footprint)")
+    ap.add_argument("--attn-impl", default=None,
+                    choices=("gather", "kernel"),
+                    help="paged-decode attention: kernel = Pallas ragged"
+                         " paged attention (ops/paged_attention.py),"
+                         " gather = reference timeline reconstitution"
+                         " (default: the llm_attn_impl config knob)")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
 
@@ -68,7 +74,7 @@ def main() -> None:
     engine = LLMEngine(cfg, params, n_slots=args.n_slots, max_len=1024,
                        decode_block=args.decode_block,
                        kv_mode=args.kv_mode, page_size=args.page_size,
-                       n_pages=args.n_pages)
+                       n_pages=args.n_pages, attn_impl=args.attn_impl)
     rng = np.random.default_rng(0)
 
     # Warm every admission-group size (8/4/2/1 batched prefill) and every
@@ -141,14 +147,23 @@ def main() -> None:
             em.get("engine_decode_tok_s", 0.0), 1),
         "engine_prefill_tok_per_s": round(
             em.get("engine_prefill_tok_s", 0.0), 1),
+        # Engine-side per-token step-time percentiles (window wall time /
+        # window size, measured inside the engine loop) — the roofline-
+        # facing number the paged-attention kernel moves.
+        "decode_step_ms_p50": em.get("decode_step_ms_p50", 0.0),
+        "decode_step_ms_p95": em.get("decode_step_ms_p95", 0.0),
         "slot_occupancy": round(em.get("slot_occupancy", 0.0), 4),
         "decode_time_s": round(em.get("decode_time_s", 0.0), 2),
         "prefill_time_s": round(em.get("prefill_time_s", 0.0), 2),
         "preemptions": em.get("preemptions", 0),
+        "decode_block": args.decode_block,
     }
     if args.kv_mode == "paged":
         row["kv_pages_total"] = em.get("kv_pages_total")
         row["kv_page_size"] = em.get("kv_page_size")
+        # Which attention implementation produced this row — kernel vs
+        # gather ablations must be distinguishable from the JSON alone.
+        row["llm_attn_impl"] = em.get("llm_attn_impl", engine.attn_impl)
     print(json.dumps(row), flush=True)
     if args.json_out:
         json.dump(row, open(args.json_out, "w"))

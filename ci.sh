@@ -41,6 +41,7 @@ case "$TIER" in
       tests/test_serve_config.py      # Serve: YAML config + REST ops
       tests/test_tracing.py           # distributed tracing across hops
       tests/test_llm_serve.py         # LLM engine: paged KV, batching
+      tests/test_paged_attention.py   # Pallas ragged paged-attn kernel
       tests/test_tune.py              # Tune: schedulers/searchers
       tests/test_workflow.py          # Workflows: DAG + resume
       tests/test_ops_layer.py         # model ops numerics
@@ -50,14 +51,20 @@ case "$TIER" in
   *) echo "usage: $0 [fast|full|quick]" >&2; exit 2 ;;
 esac
 
-# Collection guard: a silent import/collection error in the tracing module
+# Collection guard: a silent import/collection error in these modules
 # would just shrink the pass count — pytest's grep-style pass totals can't
-# tell "all passed" from "never collected". Fail loudly instead.
-collected=$(python -m pytest tests/test_tracing.py --collect-only -q \
-  -p no:cacheprovider 2>/dev/null | grep -c '^tests/test_tracing.py' || true)
-if [ "${collected}" -eq 0 ]; then
-  echo "FATAL: tests/test_tracing.py collected zero tests" >&2
-  exit 1
-fi
+# tell "all passed" from "never collected". Fail loudly instead. For
+# test_paged_attention this doubles as the pallas-import guard on
+# CPU-only boxes: a broken pallas install must fail the tier, not skip
+# the kernel tests silently (the module asserts the interpret-mode
+# fallback instead of importorskip'ing).
+for guarded in tests/test_tracing.py tests/test_paged_attention.py; do
+  collected=$(python -m pytest "${guarded}" --collect-only -q \
+    -p no:cacheprovider 2>/dev/null | grep -c "^${guarded}" || true)
+  if [ "${collected}" -eq 0 ]; then
+    echo "FATAL: ${guarded} collected zero tests" >&2
+    exit 1
+  fi
+done
 
 exec python -m pytest "${TARGET[@]}" "${ARGS[@]}"

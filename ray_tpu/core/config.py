@@ -243,6 +243,13 @@ class Config:
     llm_kv_mode: str = "dense"
     # Tokens per KV page in paged mode.
     llm_kv_page_size: int = 64
+    # Paged-decode attention implementation: "gather" (reference —
+    # reconstitute each slot's contiguous timeline per layer, exact-match
+    # with the dense engine) | "kernel" (Pallas ragged paged-attention:
+    # K/V pages read in place with online softmax, no [B, T, H, K]
+    # timeline in HBM — the throughput path on real chips; runs under
+    # interpret=True off-TPU). Env: RAY_TPU_LLM_ATTN_IMPL=kernel.
+    llm_attn_impl: str = "gather"
 
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"

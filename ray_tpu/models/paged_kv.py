@@ -16,10 +16,17 @@ XLA-first layout decisions:
 - Page 0 is a reserved null page. Table entries that aren't allocated
   point at 0; writes land there harmlessly and reads of it are always
   position-masked, so every shape stays static with no host branching.
-- Reads gather the slot's pages back into a contiguous
-  ``[B, T, H, K]`` timeline per layer (transient, inside the layer
-  scan) and run the *same* attention math as the dense path — the two
-  engines are exact-match by construction (tested).
+- Reads have two implementations, selected by the static ``attn_impl``
+  argument (engine knob ``llm_attn_impl``):
+  * ``"gather"`` (reference): gather the slot's pages back into a
+    contiguous ``[B, T, H, K]`` timeline per layer (transient, inside
+    the layer scan) and run the *same* attention math as the dense path
+    — exact-match with the dense engine by construction (tested).
+  * ``"kernel"``: the Pallas ragged paged-attention kernel
+    (ops/paged_attention.py) reads K/V pages in place from the pool
+    with online-softmax state in VMEM — no timeline is materialized in
+    HBM. Exact-match with ``"gather"`` within fp32-softmax
+    reassociation (tested); the throughput path on real chips.
 - Writes scatter at ``(table[b, pos // ps], pos % ps)``. Distinct live
   slots never share a page, so scatter indices never collide on real
   pages.
@@ -62,7 +69,9 @@ def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
     S_pad = n_pg * ps
     x = params["wte"].astype(cfg.dtype)[tokens]            # [N, S, D]
     pos = jnp.broadcast_to(jnp.arange(S)[None, :], (N, S))
-    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    # One up-front cast of the stacked block params (the per-layer
+    # `.astype(cfg.dtype)` calls inside the scan body become no-ops).
+    stacked = {k: params[k].astype(cfg.dtype) for k in _BLOCK_KEYS}
     scale = 1.0 / math.sqrt(cfg.head_dim)
     flat_pages = pages.reshape(-1)                         # [N * n_pg]
 
@@ -100,25 +109,33 @@ def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
 
 
 def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
-                       tables):
+                       tables, attn_impl: str = "gather"):
     """All B slots advance one token against the page pool.
 
-    tokens: [B]; positions: [B]; tables: [B, max_pages].
-    → (logits [B, V] fp32, updated pool). Math is identical to the dense
-    `_decode_once` — the gather reconstitutes each slot's contiguous
-    timeline [B, T, H, K] (T = max_pages × page_size) per layer.
+    tokens: [B]; positions: [B]; tables: [B, max_pages]; attn_impl
+    (static): "gather" reconstitutes each slot's contiguous timeline
+    [B, T, H, K] (T = max_pages × page_size) per layer — math identical
+    to the dense `_decode_once`; "kernel" runs the Pallas ragged
+    paged-attention kernel against the pool in place.
+    → (logits [B, V] fp32, updated pool).
     """
-    B = tokens.shape[0]
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"attn_impl must be gather|kernel, got {attn_impl!r}")
     ps = pool["k"].shape[2]
-    n_pg = tables.shape[1]
-    T = n_pg * ps
     x = params["wte"].astype(cfg.dtype)[tokens][:, None, :]  # [B, 1, D]
     pos = positions[:, None]
-    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    # Pre-cast the stacked block params once: the per-layer
+    # `layer[...].astype(cfg.dtype)` calls inside the scan body become
+    # no-ops instead of re-lowering a convert per layer per step.
+    stacked = {k: params[k].astype(cfg.dtype) for k in _BLOCK_KEYS}
     scale = 1.0 / math.sqrt(cfg.head_dim)
+    # Write target + kv length are loop-invariant across layers — computed
+    # once here, never inside the scan body.
     write_page = jnp.take_along_axis(
         tables, (positions // ps)[:, None], axis=1)[:, 0]    # [B]
     write_off = positions % ps                               # [B]
+    kv_lengths = positions + 1                               # [B]
 
     def body(x, inputs):
         layer, k_pool_l, v_pool_l = inputs
@@ -130,15 +147,24 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
             k[:, 0].astype(cfg.dtype))
         v_pool_l = v_pool_l.at[write_page, write_off].set(
             v[:, 0].astype(cfg.dtype))
-        # Gather the slot's pages into a contiguous [B, T, H, K] view.
-        k_view = k_pool_l[tables].reshape(B, T, cfg.n_heads, cfg.head_dim)
-        v_view = v_pool_l[tables].reshape(B, T, cfg.n_heads, cfg.head_dim)
-        logits = jnp.einsum("bhk,bthk->bht", q[:, 0], k_view,
-                            preferred_element_type=jnp.float32) * scale
-        mask = jnp.arange(T)[None, :] <= positions[:, None]  # [B, T]
-        logits = jnp.where(mask[:, None, :], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("bht,bthk->bhk", probs, v_view)
+        if attn_impl == "kernel":
+            # Ragged paged attention: K/V pages are read in place from
+            # the pool (one DMA per live page, pl.when-skipped null
+            # tail); no [B, T, H, K] timeline ever hits HBM.
+            from ray_tpu.ops.paged_attention import paged_attention
+
+            attn = paged_attention(q[:, 0], k_pool_l, v_pool_l, tables,
+                                   kv_lengths, sm_scale=scale)
+        else:
+            # Gather reference: reconstitute the contiguous [B, T, H, K]
+            # timeline — ONE implementation shared with the kernel's test
+            # oracle so engine-gather and oracle can never diverge.
+            from ray_tpu.ops.paged_attention import (
+                reference_paged_attention)
+
+            attn = reference_paged_attention(
+                q[:, 0], k_pool_l, v_pool_l, tables, kv_lengths,
+                sm_scale=scale)
         x = x + jnp.einsum("bhk,hkd->bd", attn,
                            layer["wo"].astype(cfg.dtype))[:, None, :]
         x = _mlp(x, layer, cfg)
@@ -150,17 +176,21 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
     return logits, {"k": new_k, "v": new_v}
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("attn_impl",), donate_argnums=(3,))
 def decode_step_paged(cfg: GPTConfig, params, tokens, pool, positions,
-                      tables):
+                      tables, *, attn_impl: str = "gather"):
     """One token for every slot against the paged pool.
     → (logits [B, V] fp32, updated pool)."""
-    return _decode_once_paged(cfg, params, tokens, pool, positions, tables)
+    return _decode_once_paged(cfg, params, tokens, pool, positions, tables,
+                              attn_impl)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 6), donate_argnums=(3,))
+@functools.partial(jax.jit, static_argnums=(0, 6),
+                   static_argnames=("attn_impl",), donate_argnums=(3,))
 def decode_multi_paged(cfg: GPTConfig, params, tokens, pool, positions,
-                       tables, n_steps: int, temps, key):
+                       tables, n_steps: int, temps, key, *,
+                       attn_impl: str = "gather"):
     """`n_steps` fused paged-decode steps with on-device sampling (the
     paged twin of decode.decode_multi — the engine pre-allocates pages
     covering positions + n_steps before dispatch, so tables are static
@@ -170,7 +200,7 @@ def decode_multi_paged(cfg: GPTConfig, params, tokens, pool, positions,
     def step(carry, _):
         toks, pos, pool, key = carry
         logits, pool = _decode_once_paged(
-            cfg, params, toks, pool, pos, tables)
+            cfg, params, toks, pool, pos, tables, attn_impl)
         key, sub = jax.random.split(key)
         greedy = jnp.argmax(logits, axis=-1)
         sampled = jax.random.categorical(

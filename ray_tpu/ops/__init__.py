@@ -1,5 +1,12 @@
 """TPU kernels (Pallas) and their XLA reference implementations."""
 
 from ray_tpu.ops.attention import flash_attention, reference_attention
+from ray_tpu.ops.paged_attention import (
+    paged_attention,
+    reference_paged_attention,
+)
 
-__all__ = ["flash_attention", "reference_attention"]
+__all__ = [
+    "flash_attention", "reference_attention",
+    "paged_attention", "reference_paged_attention",
+]

@@ -1,0 +1,196 @@
+"""Ragged paged-attention decode kernel (Pallas TPU).
+
+The serve engine's paged KV read was gather semantics: every decode step
+reconstituted each slot's contiguous ``[B, T, H, K]`` timeline from the
+page pool per layer (models/paged_kv.py), costing three KV passes over HBM
+(pool gather-read + timeline write + attention re-read) and lowering to
+XLA gathers instead of page-granular DMA — the engine-side decode gap
+measured in VERDICT.md weak #2 (311 tok/s vs an ~4 ms/step weight-traffic
+roofline at OPT-1.3B bf16 B=16). This kernel is the decode twin of the
+training flash kernel (ops/attention.py): it reads K/V pages **in place**
+from the pool and fuses QK → online softmax → V, so no timeline is ever
+materialized in HBM.
+
+Design notes:
+- Grid is (batch-slot, kv-page) with ``PrefetchScalarGridSpec``
+  (num_scalar_prefetch=2): the page table ``[B, n_pg]`` and per-slot kv
+  lengths ``[B]`` land in SMEM before the body runs, so the K/V BlockSpec
+  index maps can select block ``(tables[b, j], ...)`` — the page id IS the
+  block index into the pool. Each grid step DMAs exactly one page.
+- Online-softmax state (m, l, acc) lives in VMEM scratch across the kv
+  dimension ("arbitrary" grid semantics), exactly like the flash kernel.
+- Null / past-length pages: unallocated table tail entries are 0 (the
+  reserved null page, models/paged_kv.py), so their index maps repeat
+  block 0 and Pallas's revisit elision fetches it at most once;
+  ``pl.when(j*ps < len)`` skips their compute entirely. In-page
+  raggedness (a slot ending mid-page) is position-masked like the flash
+  kernel's kv_len mask.
+- Softmax statistics stay fp32; the QKᵀ/PV contractions run in the input
+  dtype with fp32 accumulate (MXU fast path — upcasting operands would
+  drop the MXU into its ~4x slower fp32 mode).
+- On non-TPU backends the kernel runs under ``interpret=True`` so every
+  test exercises the identical code path (same pattern as
+  ops/attention.py); a broken pallas install fails loudly in CI instead
+  of silently skipping.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(
+    tables_ref, lengths_ref,            # scalar-prefetch (SMEM)
+    q_ref, k_ref, v_ref,                # VMEM blocks
+    o_ref,
+    m_ref, l_ref, acc_ref,              # VMEM scratch
+    *, sm_scale, page_size, n_pg,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kv_len = lengths_ref[b]
+
+    def _compute():
+        q = q_ref[0]                         # [H, K]
+        k = k_ref[0]                         # [ps, H, K]
+        v = v_ref[0]
+        # s[h, t] = q[h] · k[t, h] — a per-head batched matvec; decode
+        # attention is HBM-bound (~2 flops/byte), so MXU shape efficiency
+        # is irrelevant next to reading the page once.
+        s = jnp.einsum("hk,thk->ht", q, k,
+                       preferred_element_type=jnp.float32) * sm_scale
+        # In-page raggedness: positions at or past the slot's kv length
+        # are masked (covers the null page when it IS the write target of
+        # an idle slot, and a live slot's partial last page).
+        tpos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(tpos < kv_len, s, NEG_INF)
+
+        m_prev = m_ref[...]                  # [H, LANES] (uniform rows)
+        row_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp(s - m_new[:, :1])        # [H, ps] fp32
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jnp.einsum("ht,thk->hk", p.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = m_new
+
+    # Skip pages entirely past the slot's kv length — the whole null tail
+    # of the table does no compute (its repeated block-0 index map also
+    # elides the DMA after the first fetch).
+    pl.when(j * page_size < kv_len)(_compute)
+
+    @pl.when(j == n_pg - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Single-token decode attention straight against the KV page pool.
+
+    Args:
+      q: [B, H, K] — each slot's current-token query (post-rotary).
+      k_pool, v_pool: [P, page_size, H, K] — ONE layer's page pool (row 0
+        is the reserved null page).
+      tables: [B, n_pg] int32 page ids per slot (unallocated tail = 0).
+      lengths: [B] int32 valid kv positions per slot (= position + 1; the
+        current token's K/V must already be written to its page).
+    Returns [B, H, K] in q.dtype. Numerics match the gather reference
+    within blockwise-fp32-softmax reassociation (see
+    ``reference_paged_attention``).
+    """
+    B, H, K = q.shape
+    P, ps, Hp, Kp = k_pool.shape
+    if (Hp, Kp) != (H, K) or v_pool.shape != k_pool.shape:
+        raise ValueError(
+            f"pool/query shape mismatch: q {q.shape}, k_pool {k_pool.shape},"
+            f" v_pool {v_pool.shape}")
+    n_pg = tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(K)
+    if interpret is None:
+        interpret = _interpret_default()
+    tables = tables.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=sm_scale, page_size=ps, n_pg=n_pg)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_pg),
+        in_specs=[
+            pl.BlockSpec((1, H, K), lambda b, j, tbl, lens: (b, 0, 0)),
+            pl.BlockSpec((1, ps, H, K),
+                         lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps, H, K),
+                         lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, K), lambda b, j, tbl, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, _LANES), jnp.float32),
+            pltpu.VMEM((H, _LANES), jnp.float32),
+            pltpu.VMEM((H, K), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, K), q.dtype),
+        interpret=interpret,
+    )(tables, lengths, q, k_pool, v_pool)
+
+
+def reference_paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                              sm_scale=None):
+    """Gather-semantics oracle: reconstitute each slot's contiguous
+    timeline and run plain-XLA attention — byte-for-byte the math of
+    models/paged_kv.py's gather read path (test oracle + fallback)."""
+    B, H, K = q.shape
+    ps = k_pool.shape[1]
+    T = tables.shape[1] * ps
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(K)
+    k_view = k_pool[tables].reshape(B, T, H, K)
+    v_view = v_pool[tables].reshape(B, T, H, K)
+    s = jnp.einsum("bhk,bthk->bht", q, k_view,
+                   preferred_element_type=jnp.float32) * sm_scale
+    mask = jnp.arange(T)[None, :] < lengths[:, None]        # [B, T]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bht,bthk->bhk", probs, v_view)
+
+
+__all__ = ["paged_attention", "reference_paged_attention"]

@@ -21,7 +21,9 @@ Design notes:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import math
 import queue
 import threading
 import time
@@ -31,6 +33,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ray_tpu import profiling as _profiling
+from ray_tpu import tracing
 
 # Per-request serving histograms, tagged by the ingress route (from trace
 # baggage) and the replica actor serving the request; flushed to the GCS
@@ -45,6 +48,17 @@ _DECODE_HIST = _profiling.Histogram(
     description="LLM per-request decode throughput (tokens/s after TTFT)",
     boundaries=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500),
     tag_keys=("route", "replica"))
+# Engine-side decode step latency (window wall time / window size), tagged
+# by kv/attention implementation so kernel-vs-gather runs are separable at
+# /metrics. Buckets are finer than LATENCY_BUCKETS_S: the chip-side target
+# is single-digit ms/step (HBM roofline), the client-path buckets start
+# at 5 ms.
+_DECODE_STEP_HIST = _profiling.Histogram(
+    "serve_llm_decode_step_s",
+    description="LLM engine per-token decode step latency (window / k)",
+    boundaries=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5),
+    tag_keys=("replica", "impl"))
 
 
 def _request_metric_tags() -> dict:
@@ -103,7 +117,7 @@ class LLMEngine:
                  prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512),
                  decode_block: int | None = None,
                  kv_mode: str | None = None, page_size: int | None = None,
-                 n_pages: int | None = None):
+                 n_pages: int | None = None, attn_impl: str | None = None):
         import jax
 
         from ray_tpu.models import gpt
@@ -121,16 +135,25 @@ class LLMEngine:
         self.buckets = buckets
         self.params = params if params is not None else gpt.init_params(
             cfg, jax.random.key(seed))
-        if kv_mode is None or page_size is None:
+        if kv_mode is None or page_size is None or attn_impl is None:
             from ray_tpu.core.config import runtime_config
 
             _rc = runtime_config()
             kv_mode = _rc.llm_kv_mode if kv_mode is None else kv_mode
             page_size = (_rc.llm_kv_page_size if page_size is None
                          else page_size)
+            attn_impl = (_rc.llm_attn_impl if attn_impl is None
+                         else attn_impl)
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
+        if attn_impl not in ("gather", "kernel"):
+            raise ValueError(
+                f"attn_impl must be gather|kernel, got {attn_impl!r}")
         self.kv_mode = kv_mode
+        # Paged-decode attention path (models/paged_kv.py): "kernel" = the
+        # Pallas ragged paged-attention kernel, "gather" = the exact-match
+        # reference. Dense mode ignores it.
+        self.attn_impl = attn_impl
         if kv_mode == "paged":
             # HBM holds `n_pages` pages TOTAL instead of n_slots × max_len:
             # slot count stops being bounded by the worst-case sequence
@@ -175,6 +198,13 @@ class LLMEngine:
 
         self._deferred: "collections.deque[GenRequest]" = collections.deque()
         self._rng_key = jax.random.key(seed)
+        # Per-token decode step times (window wall time / window size),
+        # milliseconds — a bounded ring so metrics() can report p50/p95
+        # step latency for the measured window (bench_serve commits them).
+        self._step_ms: "collections.deque[float]" = collections.deque(
+            maxlen=4096)
+        self._step_tags: dict | None = None   # lazy: replica id + impl
+        self._window_seq = 0                  # decode windows dispatched
         self._shutdown = threading.Event()
         self._fatal: str | None = None
         self._thread: threading.Thread | None = None
@@ -251,6 +281,39 @@ class LLMEngine:
         with self._lock:
             for k, v in self.stats.items():
                 self.stats[k] = 0 if isinstance(v, int) else 0.0
+            self._step_ms.clear()
+
+    _SPAN_SAMPLE = 64
+
+    def _window_span(self):
+        """Tracing span for 1-in-N decode windows (first window always):
+        enough to see engine step time in /api/traces without the decode
+        loop minting a fresh root trace per window — at decode rates that
+        floods the GCS per-trace index and would eventually exhaust the
+        bounded profile table, starving every other trace producer. The
+        step-latency histogram still observes EVERY window."""
+        seq, self._window_seq = self._window_seq, self._window_seq + 1
+        if seq % self._SPAN_SAMPLE == 0:
+            return tracing.start_span("llm.decode_window", cat="serve_llm")
+        return contextlib.nullcontext()
+
+    def _observe_window(self, dt: float, k: int, n_active: int) -> None:
+        """Per-decode-window accounting: engine stats, the bounded
+        per-token step-time ring behind metrics()'s p50/p95, and the
+        step-latency histogram that makes kernel-vs-gather runs
+        distinguishable at /metrics."""
+        if self._step_tags is None:
+            impl = (f"paged-{self.attn_impl}" if self.kv_mode == "paged"
+                    else "dense")
+            self._step_tags = {
+                "replica": _request_metric_tags()["replica"], "impl": impl}
+        with self._lock:
+            self.stats["decode_time_s"] += dt
+            self.stats["decode_windows"] += 1
+            self.stats["slot_step_sum"] += k * n_active
+            self.stats["slot_cap_sum"] += k * self.n_slots
+            self._step_ms.append(dt / k * 1000.0)
+        _DECODE_STEP_HIST.observe(dt / k, tags=self._step_tags)
 
     def metrics(self) -> dict:
         with self._lock:
@@ -262,6 +325,12 @@ class LLMEngine:
                 m["kv_pages_total"] = self.n_pages
                 m["kv_pages_free"] = len(self.free_pages)
                 m["kv_page_size"] = self.page_size
+                m["llm_attn_impl"] = self.attn_impl
+            if self._step_ms:
+                s = sorted(self._step_ms)
+                m["decode_step_ms_p50"] = round(s[len(s) // 2], 3)
+                m["decode_step_ms_p95"] = round(
+                    s[max(0, math.ceil(len(s) * 0.95) - 1)], 3)
         if m["completed"]:
             m["ttft_mean_s"] = m["ttft_sum"] / m["completed"]
         # Engine-side rates: what the chip sustains, independent of the
@@ -577,24 +646,23 @@ class LLMEngine:
         t0 = time.perf_counter()
         if k > 1:
             self._rng_key, sub = jax.random.split(self._rng_key)
-            if self.kv_mode == "paged":
-                from ray_tpu.models.paged_kv import decode_multi_paged
+            with self._window_span():
+                if self.kv_mode == "paged":
+                    from ray_tpu.models.paged_kv import decode_multi_paged
 
-                toks_out, self.cache = decode_multi_paged(
-                    self.cfg, self.params, jnp.asarray(self.tokens),
-                    self.cache, jnp.asarray(self.positions),
-                    jnp.asarray(table_view), k,
-                    jnp.asarray(self.temps), sub)
-            else:
-                toks_out, self.cache = decode_multi(
-                    self.cfg, self.params, jnp.asarray(self.tokens),
-                    self.cache, jnp.asarray(self.positions), k,
-                    jnp.asarray(self.temps), sub)
-            toks_out = np.asarray(toks_out)  # [k, B]
-            self.stats["decode_time_s"] += time.perf_counter() - t0
-            self.stats["decode_windows"] += 1
-            self.stats["slot_step_sum"] += k * len(active)
-            self.stats["slot_cap_sum"] += k * self.n_slots
+                    toks_out, self.cache = decode_multi_paged(
+                        self.cfg, self.params, jnp.asarray(self.tokens),
+                        self.cache, jnp.asarray(self.positions),
+                        jnp.asarray(table_view), k,
+                        jnp.asarray(self.temps), sub,
+                        attn_impl=self.attn_impl)
+                else:
+                    toks_out, self.cache = decode_multi(
+                        self.cfg, self.params, jnp.asarray(self.tokens),
+                        self.cache, jnp.asarray(self.positions), k,
+                        jnp.asarray(self.temps), sub)
+                toks_out = np.asarray(toks_out)  # [k, B]
+            self._observe_window(time.perf_counter() - t0, k, len(active))
             for slot in active:
                 req = self.slot_req[slot]
                 finished = False
@@ -608,21 +676,20 @@ class LLMEngine:
                     self.tokens[slot] = toks_out[k - 1, slot]
                     self.positions[slot] += k
             return len(active)
-        if self.kv_mode == "paged":
-            from ray_tpu.models.paged_kv import decode_step_paged
+        with self._window_span():
+            if self.kv_mode == "paged":
+                from ray_tpu.models.paged_kv import decode_step_paged
 
-            logits, self.cache = decode_step_paged(
-                self.cfg, self.params, jnp.asarray(self.tokens), self.cache,
-                jnp.asarray(self.positions), jnp.asarray(table_view))
-        else:
-            logits, self.cache = decode_step(
-                self.cfg, self.params, jnp.asarray(self.tokens), self.cache,
-                jnp.asarray(self.positions))
-        logits = np.asarray(logits)
-        self.stats["decode_time_s"] += time.perf_counter() - t0
-        self.stats["decode_windows"] += 1
-        self.stats["slot_step_sum"] += len(active)
-        self.stats["slot_cap_sum"] += self.n_slots
+                logits, self.cache = decode_step_paged(
+                    self.cfg, self.params, jnp.asarray(self.tokens),
+                    self.cache, jnp.asarray(self.positions),
+                    jnp.asarray(table_view), attn_impl=self.attn_impl)
+            else:
+                logits, self.cache = decode_step(
+                    self.cfg, self.params, jnp.asarray(self.tokens),
+                    self.cache, jnp.asarray(self.positions))
+            logits = np.asarray(logits)
+        self._observe_window(time.perf_counter() - t0, 1, len(active))
         for slot in active:
             req = self.slot_req[slot]
             if self.positions[slot] + 1 >= self.max_len:

@@ -1,0 +1,247 @@
+"""Ragged paged-attention decode kernel (ops/paged_attention.py).
+
+Exact-match of the Pallas kernel path against the gather reference across
+page sizes, ragged slot lengths, and null-page tails — at the op level, at
+the jitted decode-step level (models/paged_kv.py), and end-to-end through
+the continuous-batching engine (greedy token streams identical to the
+dense engine). On CPU the kernel runs under interpret=True: the fallback
+is ASSERTED, never silently skipped — a broken pallas install fails here.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import gpt
+from ray_tpu.ops.paged_attention import (
+    _interpret_default,
+    paged_attention,
+    reference_paged_attention,
+)
+
+CFG = gpt.GPTConfig.tiny(attn_impl="xla", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt.init_params(CFG, jax.random.key(42))
+
+
+def test_interpret_fallback_is_asserted_off_tpu():
+    """CPU-only CI must exercise the kernel code path via interpret mode —
+    if pallas failed to import, the module import above would already have
+    failed loudly (no importorskip anywhere in this file)."""
+    if jax.default_backend() != "tpu":
+        assert _interpret_default() is True
+    else:
+        assert _interpret_default() is False
+
+
+def _pool_and_tables(rng, *, B, H, K, ps, n_pg, dtype):
+    """A pool with every slot's pages allocated plus ragged lengths:
+    length 1 (fresh slot), mid-page, exact page boundary, full table, and
+    an all-null table (idle slot)."""
+    n_pages = B * n_pg + 1
+    k_pool = jnp.asarray(rng.normal(size=(n_pages, ps, H, K)), dtype)
+    v_pool = jnp.asarray(rng.normal(size=(n_pages, ps, H, K)), dtype)
+    tables = np.zeros((B, n_pg), np.int32)
+    lengths = np.zeros(B, np.int32)
+    specs = [1, ps // 2 + 1, ps, n_pg * ps, 1]
+    next_page = 1
+    for b in range(B):
+        length = specs[b % len(specs)]
+        if b == B - 1:
+            # Idle slot: table stays all-null, attends only position 0 of
+            # the null page.
+            lengths[b] = 1
+            continue
+        need = (length + ps - 1) // ps
+        for j in range(need):
+            tables[b, j] = next_page
+            next_page += 1
+        lengths[b] = length
+    return k_pool, v_pool, jnp.asarray(tables), jnp.asarray(lengths)
+
+
+@pytest.mark.parametrize("ps", [16, 64])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_kernel_matches_gather_reference(ps, dtype):
+    rng = np.random.default_rng(0)
+    B, H, K, n_pg = 5, 4, 16, 3
+    q = jnp.asarray(rng.normal(size=(B, H, K)), dtype)
+    k_pool, v_pool, tables, lengths = _pool_and_tables(
+        rng, B=B, H=H, K=K, ps=ps, n_pg=n_pg, dtype=dtype)
+    o = paged_attention(q, k_pool, v_pool, tables, lengths)
+    ref = reference_paged_attention(q, k_pool, v_pool, tables, lengths)
+    assert o.dtype == q.dtype
+    atol = 2e-6 if dtype == jnp.float32 else 3e-2
+    np.testing.assert_allclose(
+        np.asarray(o, np.float32), np.asarray(ref, np.float32), atol=atol)
+
+
+def test_kernel_single_token_slot():
+    """length=1 everywhere (the first decode step after a 1-token prompt):
+    softmax over one position must be exact."""
+    rng = np.random.default_rng(1)
+    B, H, K, ps = 2, 4, 8, 16
+    q = jnp.asarray(rng.normal(size=(B, H, K)), jnp.float32)
+    k_pool = jnp.asarray(rng.normal(size=(3, ps, H, K)), jnp.float32)
+    v_pool = jnp.asarray(rng.normal(size=(3, ps, H, K)), jnp.float32)
+    tables = jnp.asarray([[1], [2]], jnp.int32)
+    lengths = jnp.asarray([1, 1], jnp.int32)
+    o = paged_attention(q, k_pool, v_pool, tables, lengths)
+    # One valid position ⇒ output IS that position's V row.
+    np.testing.assert_allclose(
+        np.asarray(o[0]), np.asarray(v_pool[1, 0]), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(o[1]), np.asarray(v_pool[2, 0]), atol=1e-6)
+
+
+class TestDecodeStepEquivalence:
+    """kernel vs gather through the jitted decode functions: logits within
+    fp32-softmax tolerance, greedy tokens identical."""
+
+    def _setup(self, params, *, page_size, prompt_lens):
+        from ray_tpu.models.paged_kv import init_paged_kv, prefill_batch_paged
+
+        B = len(prompt_lens)
+        n_pg = 4
+        rng = np.random.default_rng(7)
+        n_pages = B * n_pg
+        pool = init_paged_kv(CFG, n_pages, page_size)
+        bucket = 16
+        padded = np.zeros((B, bucket), np.int32)
+        lengths = np.asarray(prompt_lens, np.int32)
+        for i, n in enumerate(prompt_lens):
+            padded[i, :n] = rng.integers(1, CFG.vocab_size, n)
+        pages = np.zeros((B, (bucket + page_size - 1) // page_size),
+                         np.int32)
+        tables = np.zeros((B, n_pg), np.int32)
+        nxt = 1
+        for b in range(B):
+            need = (prompt_lens[b] + page_size) // page_size + 1
+            for j in range(min(need, n_pg)):
+                tables[b, j] = nxt
+                if j < pages.shape[1]:
+                    pages[b, j] = nxt
+                nxt += 1
+        last, pool = prefill_batch_paged(
+            CFG, params, jnp.asarray(padded), pool, jnp.asarray(pages),
+            jnp.asarray(lengths))
+        toks = np.argmax(np.asarray(last), axis=-1).astype(np.int32)
+        return pool, jnp.asarray(tables), jnp.asarray(toks), jnp.asarray(
+            lengths)
+
+    @pytest.mark.parametrize("page_size", [16, 64])
+    def test_decode_step_logits_match(self, params, page_size):
+        from ray_tpu.models.paged_kv import decode_step_paged
+
+        pool, tables, toks, positions = self._setup(
+            params, page_size=page_size, prompt_lens=[3, 9, 15])
+        # Run both impls from identical pool state (copy: the jit donates).
+        pool2 = jax.tree.map(jnp.copy, pool)
+        lg_g, pool_g = decode_step_paged(
+            CFG, params, toks, pool, positions, tables, attn_impl="gather")
+        lg_k, pool_k = decode_step_paged(
+            CFG, params, toks, pool2, positions, tables, attn_impl="kernel")
+        np.testing.assert_allclose(
+            np.asarray(lg_k), np.asarray(lg_g), rtol=2e-4, atol=2e-4)
+        assert np.argmax(np.asarray(lg_k), -1).tolist() == \
+            np.argmax(np.asarray(lg_g), -1).tolist()
+        # Pool writes agree within softmax reassociation (layer l's K/V
+        # depend on layer l-1's attention output, so exact equality is
+        # only layer-0-deep; close everywhere).
+        np.testing.assert_allclose(
+            np.asarray(pool_k["k"]), np.asarray(pool_g["k"]),
+            rtol=1e-4, atol=1e-5)
+
+    def test_decode_multi_tokens_match(self, params):
+        from ray_tpu.models.paged_kv import decode_multi_paged
+
+        pool, tables, toks, positions = self._setup(
+            params, page_size=16, prompt_lens=[3, 9, 15])
+        pool2 = jax.tree.map(jnp.copy, pool)
+        temps = jnp.zeros(3, jnp.float32)          # greedy
+        key = jax.random.key(0)
+        out_g, _ = decode_multi_paged(
+            CFG, params, toks, pool, positions, tables, 8, temps, key,
+            attn_impl="gather")
+        out_k, _ = decode_multi_paged(
+            CFG, params, toks, pool2, positions, tables, 8, temps, key,
+            attn_impl="kernel")
+        assert np.asarray(out_k).tolist() == np.asarray(out_g).tolist()
+
+
+class TestEngineKernelPath:
+    """LLMEngine(attn_impl="kernel"): token streams byte-identical to the
+    dense engine, including under pool pressure (preempt-by-recompute)."""
+
+    def _run(self, params, prompts, *, max_tokens=6, **kw):
+        from ray_tpu.serve.llm import LLMEngine
+
+        eng = LLMEngine(CFG, params, n_slots=4, max_len=64,
+                        prefill_buckets=(16,), **kw)
+        reqs = [eng.submit(p, max_tokens=max_tokens) for p in prompts]
+        for _ in range(500):
+            if all(r.done.is_set() for r in reqs):
+                break
+            eng.step()
+        assert all(r.done.is_set() for r in reqs)
+        assert all(r.error is None for r in reqs)
+        return [r.out_ids for r in reqs], eng
+
+    def test_kernel_engine_matches_dense(self, params):
+        prompts = [[5, 9, 2], [17, 3], [1, 2, 3, 4, 5, 6, 7], [11]]
+        dense, _ = self._run(params, prompts, kv_mode="dense")
+        kernel, eng = self._run(params, prompts, kv_mode="paged",
+                                page_size=16, attn_impl="kernel")
+        assert kernel == dense
+        m = eng.metrics()
+        assert m["llm_attn_impl"] == "kernel"
+        assert m["kv_pages_free"] == m["kv_pages_total"]
+
+    def test_kernel_engine_under_preemption(self, params):
+        """Pool sized to force mid-generation eviction: the kernel path
+        recomputes victims exactly like gather."""
+        prompts = [[5, 9, 2], [17, 3], [2, 4, 6], [8, 1, 0]]
+        dense, _ = self._run(params, prompts, kv_mode="dense",
+                             max_tokens=10)
+        kernel, eng = self._run(params, prompts, kv_mode="paged",
+                                page_size=4, n_pages=7, max_tokens=10,
+                                attn_impl="kernel")
+        assert kernel == dense
+        assert eng.metrics()["preemptions"] > 0
+
+    def test_gather_knob_restores_reference_path(self, params):
+        """llm_attn_impl=gather is byte-identical to the pre-kernel
+        engine (which is itself exact-match with dense, tested in
+        test_llm_serve.py)."""
+        prompts = [[5, 9, 2], [17, 3]]
+        g, eng = self._run(params, prompts, kv_mode="paged", page_size=16,
+                           attn_impl="gather")
+        k, _ = self._run(params, prompts, kv_mode="paged", page_size=16,
+                         attn_impl="kernel")
+        assert eng.metrics()["llm_attn_impl"] == "gather"
+        assert g == k
+
+    def test_decode_step_observability(self, params):
+        """The engine loop emits per-window tracing spans + the step
+        latency histogram + p50/p95 step-time metrics (the knobs the
+        bench commits and /metrics exposes)."""
+        from ray_tpu import profiling
+        from ray_tpu.serve.llm import _DECODE_STEP_HIST
+
+        _, eng = self._run(params, [[5, 9, 2], [7, 7]], kv_mode="paged",
+                           page_size=16, attn_impl="kernel", max_tokens=8)
+        m = eng.metrics()
+        assert m["decode_step_ms_p50"] > 0
+        assert m["decode_step_ms_p95"] >= m["decode_step_ms_p50"]
+        spans = [e for e in profiling.peek_events()
+                 if e.get("name") == "llm.decode_window"]
+        assert spans, "engine decode windows emitted no tracing spans"
+        assert all("trace_id" in s.get("args", {}) for s in spans)
+        counts, _sums = _DECODE_STEP_HIST.snapshot_hist()
+        assert any("paged-kernel" in k for k in counts), (
+            "step-latency histogram has no paged-kernel series")
