@@ -47,6 +47,7 @@ case "$TIER" in
       tests/test_ops_layer.py         # model ops numerics
       tests/test_rllib_eval.py        # RLlib: eval workers + callbacks
       tests/test_sharding_audit.py    # SPMD audit arithmetic
+      tests/test_graftlint.py         # static-analysis rules + baseline
     ) ;;
   *) echo "usage: $0 [fast|full|quick]" >&2; exit 2 ;;
 esac
@@ -58,7 +59,8 @@ esac
 # CPU-only boxes: a broken pallas install must fail the tier, not skip
 # the kernel tests silently (the module asserts the interpret-mode
 # fallback instead of importorskip'ing).
-for guarded in tests/test_tracing.py tests/test_paged_attention.py; do
+for guarded in tests/test_tracing.py tests/test_paged_attention.py \
+               tests/test_graftlint.py; do
   collected=$(python -m pytest "${guarded}" --collect-only -q \
     -p no:cacheprovider 2>/dev/null | grep -c "^${guarded}" || true)
   if [ "${collected}" -eq 0 ]; then
@@ -66,5 +68,20 @@ for guarded in tests/test_tracing.py tests/test_paged_attention.py; do
     exit 1
   fi
 done
+
+# Static analysis gate (fast/quick tiers, before pytest): graftlint over
+# the runtime against the committed baseline — a NEW jit-closure,
+# blocked-event-loop, or swallowed-exception hazard fails the tier before
+# any test runs. Degrades gracefully on trees without a committed
+# baseline (fresh forks): advisory-only, since every historical finding
+# would read as "new" there.
+if [ "$TIER" = "fast" ] || [ "$TIER" = "quick" ]; then
+  if [ -f tools/graftlint/baseline.json ]; then
+    python -m tools.graftlint ray_tpu/
+  else
+    echo "ci.sh: no graftlint baseline committed — advisory lint only" >&2
+    python -m tools.graftlint ray_tpu/ || true
+  fi
+fi
 
 exec python -m pytest "${TARGET[@]}" "${ARGS[@]}"

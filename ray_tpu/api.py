@@ -290,8 +290,16 @@ class RemoteFunction:
             # snapshotted into the pickle — hold live refs alongside the
             # cached blob so the objects can't be GC'd while the function
             # remains callable (borrowed-ref parity for captures).
-            with serialization.capture_refs() as caps:
-                self._fn_blob = serialization.pack(self._fn)
+            try:
+                with serialization.capture_refs() as caps:
+                    self._fn_blob = serialization.pack(self._fn)
+            except Exception as e:
+                from ray_tpu.utils.check_serialize import serialization_error
+
+                raise serialization_error(
+                    self._fn,
+                    name=getattr(self._fn, "__name__", None),
+                    kind="remote function", cause=e) from e
             self._captured_refs = [ObjectRef(ObjectID(o)) for o in caps]
         return self._fn_blob
 
@@ -421,8 +429,16 @@ class ActorClass:
 
     def _blob(self) -> bytes:
         if self._cls_blob is None:
-            with serialization.capture_refs() as caps:
-                self._cls_blob = serialization.pack(self._cls)
+            try:
+                with serialization.capture_refs() as caps:
+                    self._cls_blob = serialization.pack(self._cls)
+            except Exception as e:
+                from ray_tpu.utils.check_serialize import serialization_error
+
+                raise serialization_error(
+                    self._cls,
+                    name=getattr(self._cls, "__name__", None),
+                    kind="actor class", cause=e) from e
             self._captured_refs = [ObjectRef(ObjectID(o)) for o in caps]
         return self._cls_blob
 

@@ -344,7 +344,7 @@ class CoreClient:
             for c in conns:
                 try:
                     await c.close()
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (shutdown: peers may already be gone)
                     pass
             # Retire cancelled read-loop tasks before the loop stops, else
             # interpreter exit logs "Task was destroyed but it is pending".
@@ -356,12 +356,12 @@ class CoreClient:
 
         try:
             self._run(_close_all(), timeout=3)
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-SWALLOW (shutdown: bounded best-effort drain)
             pass
         try:
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=2)
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-SWALLOW (shutdown: loop may already be stopped)
             pass
 
     # ------------------------------------------------------------ objects
@@ -396,8 +396,11 @@ class CoreClient:
                 try:
                     await self.raylet.call(
                         "store_release", {"object_ids": [oid]}, timeout=10)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # A lost unpin keeps the extent pinned until node GC —
+                    # a slow store leak, so it must at least be visible.
+                    logger.debug("store_release of %s failed: %s",
+                                 oid.hex()[:12], e)
 
             try:
                 self._spawn_bg(_unpin())
@@ -834,16 +837,42 @@ class CoreClient:
 
         specs: list[ArgSpec] = []
         escrow: list[bytes] = []
+        labels = ([f"args[{i}]" for i in range(len(args))]
+                  + [f"kwargs[{k!r}]" for k in kwargs])
         flat = list(args) + list(kwargs.values())
-        for a in flat:
+        try:
+            self._build_arg_specs(labels, flat, specs, escrow)
+        except BaseException:
+            # A later argument failed to serialize: undo the escrow
+            # increfs already taken for earlier ones, or their objects
+            # stay pinned forever on this designed error path.
+            for oid in escrow:
+                self.refcounter.decref(oid)
+            raise
+        return specs, list(kwargs.keys()), escrow
+
+    def _build_arg_specs(self, labels, flat, specs: list[ArgSpec],
+                         escrow: list[bytes]) -> None:
+        from ray_tpu.api import ObjectRef
+
+        for label, a in zip(labels, flat):
             if isinstance(a, ObjectRef):
                 oid = a.id.binary()
                 self.refcounter.incref(oid)
                 escrow.append(oid)
                 specs.append(ArgSpec(kind="ref", object_id=oid))
             else:
-                with serialization.capture_refs() as nested:
-                    head, views = serialization.serialize(a)
+                try:
+                    with serialization.capture_refs() as nested:
+                        head, views = serialization.serialize(a)
+                except Exception as e:
+                    from ray_tpu.utils.check_serialize import (
+                        serialization_error,
+                    )
+
+                    raise serialization_error(
+                        a, name=label, kind="task argument",
+                        cause=e) from e
                 for oid in nested:
                     self.refcounter.incref(oid)
                     escrow.append(oid)
@@ -858,7 +887,6 @@ class CoreClient:
                     data = bytearray(size)
                     serialization.write_to(memoryview(data), head, views)
                     specs.append(ArgSpec(kind="value", value=bytes(data)))
-        return specs, list(kwargs.keys()), escrow
 
     def submit_task(
         self,
@@ -1063,7 +1091,9 @@ class CoreClient:
                         "task_id": pt["spec"].task_id, "force": force,
                     }, timeout=10)
                     return True
-                except Exception:
+                except Exception as e:
+                    logger.debug("cancel_task rpc to actor worker failed "
+                                 "(worker likely dying): %s", e)
                     return False
             return False
         pt.canceled = True
@@ -1085,7 +1115,7 @@ class CoreClient:
                     "task_id": pt.spec.task_id, "force": force,
                 }, timeout=10)
                 return bool(r.get("ok"))
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-SWALLOW
                 # force-kill drops the connection before the reply lands;
                 # the lane's canceled check finishes the job.
                 return force
@@ -1119,7 +1149,11 @@ class CoreClient:
                 try:
                     locs = await self.gcs.call(
                         "obj_loc_get", {"object_id": oid}, timeout=30)
-                except Exception:
+                except Exception as e:
+                    # GCS outage mid-resolve: retried on the poll below,
+                    # but an invisible retry loop is a debugging hole.
+                    logger.debug("obj_loc_get %s failed (retrying): %s",
+                                 oid.hex()[:12], e)
                     locs = None
                 if locs or oid in self._memory_store:
                     break
@@ -1338,8 +1372,10 @@ class CoreClient:
                     if holder_id in info.get("holders", ()):
                         remaining.discard(oid)
                         self.refcounter.decref(oid)
-            except Exception:
-                pass
+            except Exception as e:
+                # Retried until the deadline warning below — but each miss
+                # extends escrow lifetime, so leave a trace.
+                logger.debug("ref_debug poll failed (retrying): %s", e)
             if not remaining:
                 return
             if asyncio.get_running_loop().time() >= deadline:
@@ -1356,8 +1392,10 @@ class CoreClient:
             await lessor.call("release_lease", {
                 "worker_id": worker_id, "dead": dead,
             }, timeout=5)
-        except Exception:
-            pass
+        except Exception as e:
+            # An unreleased lease pins pool capacity until the raylet's
+            # own worker-death sweep reclaims it — visible, not fatal.
+            logger.debug("release_lease for %s failed: %s", worker_id, e)
 
     def _record_returns(self, spec: TaskSpec, reply: dict) -> None:
         if os.environ.get("RAY_TPU_DEBUG_ACTOR_PUSH"):
@@ -1868,12 +1906,12 @@ class CoreClient:
                     await conn.call("kill_actor", {
                         "actor_id": actor_id, "no_restart": no_restart,
                     }, timeout=2)
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (kill target may already be dead)
                     pass
 
             try:
                 self._run(_send_kill())
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-SWALLOW (kill is best-effort by contract)
                 pass
 
     # -------------------------------------------------- kv

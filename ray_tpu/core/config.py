@@ -10,8 +10,11 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 from typing import Any
+
+logger = logging.getLogger(__name__)
 
 _ENV_PREFIX = "RAY_TPU_"
 
@@ -296,8 +299,11 @@ def current_config() -> Config:
     if raw:
         try:
             return Config.from_json(raw)
-        except Exception:
-            pass
+        except Exception as e:
+            # A worker silently running on env defaults instead of the
+            # raylet-forwarded config is a classic split-brain source.
+            logger.warning("malformed %s (falling back to env): %s",
+                           CONFIG_ENV_JSON, e)
     return Config.from_env()
 
 
@@ -311,6 +317,6 @@ def runtime_config() -> Config:
 
         if _api._client is not None:
             return _api._client.config
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-SWALLOW (documented never-raises contract; falls back to process config)
         pass
     return current_config()

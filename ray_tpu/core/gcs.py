@@ -414,8 +414,12 @@ class GcsServer:
                             await c2.call("pg_return", {
                                 "pg_id": pg_id, "bundle_index": j,
                             }, timeout=self.config.rpc_default_timeout_s)
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            # A lost rollback strands the bundle's resources
+                            # on that raylet until its next resync.
+                            logger.warning(
+                                "pg %s rollback on node %s failed: %s",
+                                pg_id.hex()[:12], node_id2.hex()[:12], e)
                 return {"ok": False, "error": r.get("error", "reserve failed")}
             reserved.append((node_id, i))
             # Keep the GCS resource view in sync immediately (heartbeats
@@ -449,8 +453,12 @@ class GcsServer:
                     await node_conn.call("pg_return", {
                         "pg_id": p["pg_id"], "bundle_index": b["index"],
                     }, timeout=self.config.rpc_default_timeout_s)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.warning(
+                        "pg %s bundle %d return on node %s failed "
+                        "(resources stranded until raylet resync): %s",
+                        p["pg_id"].hex()[:12], b["index"],
+                        b["node_id"].hex()[:12], e)
             # Keep the GCS view in sync (mirror of pg_create's decrement).
             info = self.nodes.get(b["node_id"])
             if info is not None:

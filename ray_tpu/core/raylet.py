@@ -415,7 +415,7 @@ class Raylet:
         if h.conn is not None:
             try:
                 h.conn.notify("exit", {})
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-SWALLOW (worker already dead = already reaped)
                 pass
         self.workers.pop(h.worker_id, None)
 
@@ -532,7 +532,11 @@ class Raylet:
                                 "lines": lines[i:i + 200],
                             },
                         }, timeout=self.config.rpc_default_timeout_s)
-                    except Exception:
+                    except Exception as e:
+                        # Dropped log batch — the monitor retries from the
+                        # file offset next tick, but note the gap.
+                        logger.debug("log publish failed (retry next "
+                                     "tick): %s", e)
                         break
 
     # ------------------------------------------------- memory protection
@@ -633,7 +637,7 @@ class Raylet:
                         "node_id": NodeID(self.node_id).hex(),
                         "pid": victim.pid,
                     }))
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (event emit is advisory; the kill itself already happened)
                     pass
                 # disconnect handling returns resources + pumps the queue
             except Exception:
@@ -1196,8 +1200,12 @@ class Raylet:
                     await self.gcs.call("obj_request_recovery", {
                         "object_ids": [obj.binary()]},
                         timeout=self.config.rpc_default_timeout_s)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # Recovery request lost: the object stays unavailable
+                    # until the next store_get poll retries — log it, a
+                    # silent drop here looks exactly like a refcount bug.
+                    logger.debug("obj_request_recovery %s failed: %s",
+                                 obj.hex()[:12], e)
                 return False
             # Randomize holder order so a broadcast (N nodes pulling one hot
             # object) spreads across replicas as copies appear, instead of
@@ -1243,8 +1251,8 @@ class Raylet:
                                     "object_id": obj.binary(),
                                     "token": info.get("serve_token", ""),
                                 }, timeout=5.0)
-                            except Exception:
-                                pass  # slot TTL reclaims it
+                            except Exception:  # graftlint: disable=EXC-SWALLOW (read-slot TTL reclaims it)
+                                pass
                     await self.gcs.call("obj_loc_add", {
                         "object_ids": [obj.binary()],
                         "node_id": self.node_id,

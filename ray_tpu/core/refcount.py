@@ -153,8 +153,11 @@ class ReferenceCounter:
             self._uncertain.discard(oid)
         try:
             self._client._on_local_release(oid)
-        except Exception:
-            pass
+        except Exception as e:
+            # Called from GC contexts that must never raise — but a failed
+            # release skips cache eviction, which reads as a memory leak.
+            logger.debug("local release hook for %s failed: %s",
+                         oid.hex()[:12], e)
 
     def decref_deferred(self, oid: bytes) -> None:
         """GC-safe decref: lock-free enqueue, applied on the next drain."""

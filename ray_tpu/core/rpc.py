@@ -121,7 +121,7 @@ class Connection:
             self._pending.clear()
             try:
                 self.writer.close()
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-SWALLOW (teardown: transport may already be torn)
                 pass
 
     async def _serve_one(self, seq: int, method: str, payload: Any):
@@ -134,7 +134,7 @@ class Connection:
             if not self.closed:
                 try:
                     _write_frame(self.writer, (ERROR, seq, method, e))
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (unpicklable error degrades to repr, not lost)
                     _write_frame(
                         self.writer, (ERROR, seq, method, RpcError(repr(e)))
                     )
@@ -167,7 +167,7 @@ class Connection:
         try:
             self.writer.close()
             await self.writer.wait_closed()
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-SWALLOW (teardown: transport may already be torn)
             pass
 
 

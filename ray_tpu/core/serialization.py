@@ -15,12 +15,15 @@ Wire format of a serialized object:
 
 from __future__ import annotations
 
+import logging
 import pickle
 import struct
 import threading
 from typing import Any, Callable
 
 import cloudpickle
+
+logger = logging.getLogger(__name__)
 
 _ALIGN = 8
 
@@ -107,8 +110,11 @@ def _ensure_by_value(obj: Any) -> None:
     try:
         cloudpickle.register_pickle_by_value(mod)
         _BY_VALUE_REGISTERED.add(mod_name)
-    except Exception:
-        pass
+    except Exception as e:
+        # Falls back to by-reference pickling: the worker will need the
+        # module importable, which surfaces later as a confusing
+        # ModuleNotFoundError — record why registration failed here.
+        logger.debug("register_pickle_by_value(%s) failed: %s", mod_name, e)
 
 
 def serialize(value: Any) -> tuple[bytes, list[memoryview]]:

@@ -70,8 +70,10 @@ class _CancellableExecutor:
                     fut.set_result(fn())
                 except BaseException as e:  # noqa: BLE001
                     fut.set_exception(e)
-            except BaseException:  # noqa: BLE001
-                # Stray late _Cancelled between items: absorb, keep serving.
+            except BaseException:  # graftlint: disable=EXC-SWALLOW
+                # Stray late _Cancelled between items: absorb, keep serving
+                # (the pool thread must never die — queued futures would
+                # hang forever).
                 continue
 
     def submit(self, fn, *args, **kwargs):
@@ -592,8 +594,12 @@ class Worker:
                         "store_free", {"object_ids": stored}, timeout=30))
                     client._run(client.gcs.call(
                         "obj_free", {"object_ids": stored}, timeout=30))
-                except Exception:
-                    pass
+                except Exception as e:
+                    # The original generator error (re-raised below) matters
+                    # more, but a failed free leaks the partial stream.
+                    logger.debug(
+                        "freeing %d partial dynamic returns failed: %s",
+                        len(stored), e)
             raise
         return refs
 
@@ -652,7 +658,7 @@ class Worker:
         await self._exit.wait()
         try:
             self.raylet.notify("worker_exiting", {"worker_id": self.worker_id})
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-SWALLOW (exiting anyway; raylet reaps us on disconnect)
             pass
 
 

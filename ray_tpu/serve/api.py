@@ -7,6 +7,7 @@ serve.run), `_private/router.py:62` (power-of-two-choices replica selection),
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -14,6 +15,8 @@ from typing import Any, Callable
 
 import ray_tpu
 from ray_tpu.core import serialization
+
+logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "ray_tpu_serve_controller"
 _local = threading.local()
@@ -40,8 +43,11 @@ def _pushed_version() -> int:
 
         try:
             client.subscribe_channel(ROUTES_CHANNEL, on_push)
-        except Exception:
-            pass
+        except Exception as e:
+            # Without the push channel every handle falls back to TTL
+            # polling — correct but slower to see redeploys; say so once.
+            logger.debug("routes push subscription failed (handles will "
+                         "poll): %s", e)
     return _push_state["version"]
 
 
@@ -73,11 +79,11 @@ def shutdown():
         return
     try:
         ray_tpu.get(ctrl.shutdown.remote(), timeout=60)
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-SWALLOW (shutdown: controller may be mid-crash; kill below finishes it)
         pass
     try:
         ray_tpu.kill(ctrl)
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-SWALLOW (shutdown: already dead is success)
         pass
 
 
@@ -163,8 +169,9 @@ class DeploymentHandle:
         self._local_inflight: dict[bytes, int] = {}
         try:
             _pushed_version()  # arm the process-level push subscription
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("push subscription arm failed (handle will "
+                         "poll): %s", e)
 
     def _refresh(self, force: bool = False):
         ctrl = _get_controller()
@@ -204,8 +211,8 @@ class DeploymentHandle:
                 break
             try:
                 self._refresh(force=not replicas)
-            except Exception:
-                pass  # controller mid-restart: serve from cache below
+            except Exception:  # graftlint: disable=EXC-SWALLOW (controller mid-restart: serve from cache below)
+                pass
             with self._lock:
                 replicas = self._alive(self._replicas)
             if replicas:
@@ -222,14 +229,17 @@ class DeploymentHandle:
                 ctrl = _get_controller()
                 woke = ray_tpu.get(ctrl.request_scale_up.remote(
                     self.deployment_name), timeout=30)
-            except Exception:
-                pass
+            except Exception as e:
+                # No verdict = no cold-start wait below; surface why the
+                # scale-to-zero wake-up couldn't be requested.
+                logger.warning("scale-up request for %s failed: %s",
+                               self.deployment_name, e)
             deadline = time.monotonic() + self.COLD_START_TIMEOUT_S
             while woke and time.monotonic() < deadline:
                 time.sleep(0.5)
                 try:
                     self._refresh(force=True)
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (cold-start poll: retried until the deadline)
                     continue
                 with self._lock:
                     replicas = self._alive(self._replicas)
@@ -285,7 +295,9 @@ class DeploymentHandle:
 
         try:
             _api._ensure_client().get_future(ref).add_done_callback(_done)
-        except Exception:
+        except Exception:  # graftlint: disable=EXC-SWALLOW
+            # Client torn down mid-dispatch: settle the inflight counter
+            # immediately so the p2c signal can't leak a phantom request.
             _done(None)
 
     def remote(self, *args, **kwargs):

@@ -11,10 +11,13 @@ routing-table invalidations to handles/proxies over GCS pubsub
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
 from typing import Any
+
+logger = logging.getLogger(__name__)
 
 ROUTES_CHANNEL = "serve_routes"
 CKPT_NS = "serve"
@@ -73,13 +76,19 @@ class ServeController:
 
         try:
             raw = _api._ensure_client().kv_get(CKPT_NS, CKPT_KEY)
-        except Exception:
+        except Exception as e:
+            # Unreadable checkpoint on controller start = every deployment
+            # silently forgotten. Must be loud.
+            logger.warning("controller checkpoint read failed (starting "
+                           "empty): %s", e)
             raw = None
         if not raw:
             return
         try:
             snap = serialization.unpack(raw)
-        except Exception:
+        except Exception as e:
+            logger.warning("controller checkpoint corrupt (starting "
+                           "empty): %s", e)
             return
         for name, rec in snap.get("deployments", {}).items():
             d = {k: rec[k] for k in _CKPT_FIELDS}
@@ -135,8 +144,10 @@ class ServeController:
                             return  # a newer snapshot supersedes this one
                     _api._ensure_client().kv_put(
                         CKPT_NS, CKPT_KEY, bytes(blob))
-            except Exception:
-                pass
+            except Exception as e:
+                # A lost snapshot means the NEXT controller restart loses
+                # state — the failure must not wait until then to surface.
+                logger.warning("controller checkpoint write failed: %s", e)
 
         threading.Thread(target=_write, daemon=True).start()
 
@@ -311,8 +322,9 @@ class ServeController:
                 from ray_tpu import api as _api
 
                 _api._ensure_client().publish(ROUTES_CHANNEL, {"version": v})
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("routes push v%d failed (handles fall back "
+                             "to TTL polling): %s", v, e)
 
         threading.Thread(target=_publish, daemon=True).start()
 
@@ -326,7 +338,7 @@ class ServeController:
         for _aid, handle in victims:
             try:
                 ray_tpu.kill(handle)
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-SWALLOW (drain target may already be dead)
                 pass
         d["replicas"] = [] if all else d["replicas"][:keep]
 
@@ -336,7 +348,9 @@ class ServeController:
             try:
                 self._reconcile_once()
             except Exception:
-                pass
+                # The reconcile loop IS the control plane: if every tick
+                # fails, replicas never heal — keep looping, but loudly.
+                logger.exception("reconcile tick failed")
             time.sleep(interval)
 
     def _autoscale_decision(self, d: dict, stats: list | None) -> None:
@@ -424,13 +438,13 @@ class ServeController:
                 try:
                     ref = (handle.stats.remote() if wants_stats
                            else handle.health.remote())
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (failed probe submit IS the unhealthy verdict — strikes accrue below)
                     ref = None
                 probes.append((name, aid, ref, wants_stats, False))
             for aid, handle, _spawned in starting:
                 try:
                     ref = handle.health.remote()
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (failed probe submit IS the unhealthy verdict)
                     ref = None
                 probes.append((name, aid, ref, False, True))
         ready_ids: set = set()
@@ -440,8 +454,11 @@ class ServeController:
                 ready, _pending = ray_tpu.wait(
                     refs, num_returns=len(refs), timeout=probe_timeout)
                 ready_ids = {r.id.binary() for r in ready}
-            except Exception:
-                pass
+            except Exception as e:
+                # Every probe reads as unready this tick → strikes for all
+                # replicas at once. That mass-unhealthy signal needs a why.
+                logger.warning("health probe wait failed (all replicas "
+                               "strike this tick): %s", e)
         # name → (gen, drop_serving, promote, drop_starting, stats)
         probed: dict[str, tuple] = {
             name: (gen, set(), set(), set(), [] if wants_stats else None)
@@ -459,7 +476,7 @@ class ServeController:
                         stats.append(s)
                 except ActorDiedError:
                     died = True
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (failed probe read = unhealthy verdict; strike accrues)
                     pass
             if is_starting:
                 # STARTING replicas: no strikes — unready is their normal
@@ -522,7 +539,7 @@ class ServeController:
                         # Stuck boot: replace it (capacity loop below).
                         try:
                             ray_tpu.kill(h)
-                        except Exception:
+                        except Exception:  # graftlint: disable=EXC-SWALLOW (kill target may already be dead)
                             pass
                         changed = True
                     else:
@@ -537,7 +554,7 @@ class ServeController:
                         _aid, h, _t = d["starting"].pop()
                         try:
                             ray_tpu.kill(h)
-                        except Exception:
+                        except Exception:  # graftlint: disable=EXC-SWALLOW (kill target may already be dead)
                             pass
                     else:
                         self._drain_replicas(d, keep=d["num_replicas"])

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import threading
 import time
@@ -31,6 +32,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ray_tpu import profiling, tracing
+
+logger = logging.getLogger(__name__)
 
 _REASONS = {
     200: b"OK", 400: b"Bad Request", 404: b"Not Found",
@@ -87,8 +90,9 @@ class _RouterMixin:
 
             _api._ensure_client().subscribe_channel(
                 ROUTES_CHANNEL, lambda _p: self._route_dirty.set())
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("routes push subscription failed (proxy falls "
+                         "back to interval refresh): %s", e)
         self._refresher = threading.Thread(target=self._refresh_loop,
                                            daemon=True)
         self._refresher.start()
@@ -131,8 +135,11 @@ class _RouterMixin:
                             for name, r in table["routes"].items()
                             if r["route_prefix"]
                         }
-            except Exception:
-                pass
+            except Exception as e:
+                # Serve from the stale table; refreshed next tick — but a
+                # permanently failing refresh must not be invisible.
+                logger.debug("route table refresh failed (serving stale "
+                             "routes): %s", e)
 
 
 class HTTPProxy(_RouterMixin):
@@ -200,7 +207,7 @@ class HTTPProxy(_RouterMixin):
             try:
                 await self._send(writer, 503,
                                  b'{"error": "too many connections"}')
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-SWALLOW (client gone before the 503 landed)
                 pass
             finally:
                 writer.close()
@@ -269,7 +276,7 @@ class HTTPProxy(_RouterMixin):
             self._conns -= 1
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-SWALLOW (teardown: socket may already be torn)
                 pass
 
     async def _send(self, writer, status: int, body: bytes,
@@ -349,7 +356,7 @@ class HTTPProxy(_RouterMixin):
                     await self._send(
                         writer, 500, json.dumps({"error": str(e)}).encode(),
                         extra=trace_headers)
-                except Exception:
+                except Exception:  # graftlint: disable=EXC-SWALLOW (client gone before the 500 landed; original error already bound)
                     return True
                 return False
             finally:
@@ -462,7 +469,7 @@ class HTTPProxy(_RouterMixin):
                 writer.write(b"data: " + json.dumps(
                     {"error": str(e)}).encode() + b"\n\n")
                 await writer.drain()
-            except Exception:
+            except Exception:  # graftlint: disable=EXC-SWALLOW (client gone mid-stream; error already surfaced as SSE event)
                 pass
         return True
 

@@ -75,7 +75,7 @@ def _request_metric_tags() -> dict:
         aid = _api.get_runtime_context().get_actor_id()
         if aid:
             replica = aid[:8]
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-SWALLOW (metric tag enrichment only; "local" is the documented fallback)
         pass
     return {"route": route, "replica": replica}
 

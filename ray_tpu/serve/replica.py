@@ -75,8 +75,8 @@ class Replica:
                 ok = ray_tpu.get(
                     ctrl.is_member.remote(self._deployment_name, my_id),
                     timeout=10)
-            except Exception:
-                strikes = 0  # no verdict without a healthy controller
+            except Exception:  # graftlint: disable=EXC-SWALLOW (no verdict without a healthy controller; keep serving is the designed outcome)
+                strikes = 0
                 continue
             strikes = strikes + 1 if not ok else 0
             if strikes >= 2:

@@ -33,7 +33,10 @@ from __future__ import annotations
 
 import dataclasses
 import importlib
+import logging
 from typing import Any
+
+logger = logging.getLogger(__name__)
 
 _OVERRIDE_FIELDS = (
     "num_replicas", "max_concurrent_queries", "user_config",
@@ -248,7 +251,11 @@ def deploy_config(cfg: ServeConfig, *, blocking: bool = True,
     try:
         known = [k.decode() if isinstance(k, bytes) else k
                  for k in kv.kv_keys(_APPS_NS)]
-    except Exception:
+    except Exception as e:
+        # Stale apps can't be discovered → nothing is torn down this
+        # apply. Declared state still deploys, but say why cleanup skipped.
+        logger.warning("app manifest listing failed (skipping stale-app "
+                       "teardown): %s", e)
         known = []
     for stale_app in sorted(set(known) - {a.name for a in cfg.applications}):
         raw = kv.kv_get(_APPS_NS, stale_app.encode())
@@ -256,8 +263,11 @@ def deploy_config(cfg: ServeConfig, *, blocking: bool = True,
                                - all_declared):
             try:
                 serve.delete(dep_name)
-            except Exception:
-                pass
+            except Exception as e:
+                # The undeclared deployment keeps running — that's config
+                # drift, the one thing declarative apply exists to prevent.
+                logger.warning("teardown of stale deployment %s failed: %s",
+                               dep_name, e)
         kv.kv_del(_APPS_NS, stale_app.encode())
     return result
 
@@ -271,13 +281,13 @@ def app_statuses() -> dict:
 
     try:
         deps = serve.status()
-    except Exception:
-        deps = {}   # no controller yet → empty state, not a crash
+    except Exception:  # graftlint: disable=EXC-SWALLOW (no controller yet → empty state, not a crash)
+        deps = {}
     kv = _kv_client()
     apps = {}
     try:
         names = kv.kv_keys(_APPS_NS)
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-SWALLOW (status query: unreachable KV reads as zero applications)
         names = []
     for key in names:
         name = key.decode() if isinstance(key, bytes) else key
@@ -304,8 +314,9 @@ def delete_app(name: str) -> list[str]:
     for dep in manifest:
         try:
             serve.delete(dep)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning("delete of deployment %s (app %s) failed: %s",
+                           dep, name, e)
     kv.kv_del(_APPS_NS, name.encode())
     return manifest
 

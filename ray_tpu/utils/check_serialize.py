@@ -27,7 +27,7 @@ def _try(obj) -> bool:
     try:
         cloudpickle.dumps(obj)
         return True
-    except Exception:
+    except Exception:  # graftlint: disable=EXC-SWALLOW (this IS the serializability probe; failure is the answer)
         return False
 
 
@@ -66,3 +66,43 @@ def inspect_serializability(
     if not found_inner:
         failures.append(FailureTuple(obj, name, _parent))
     return False, failures
+
+
+def serialization_error(obj: Any, *, name: str | None = None,
+                        kind: str = "object",
+                        cause: BaseException | None = None) -> TypeError:
+    """Build a TypeError that localizes WHICH inner value failed to pickle.
+
+    The submit path (`.remote()`) calls this when `pack`/`serialize`
+    raises: instead of a bare cloudpickle traceback pointing at pickle
+    internals, the user sees the culprit chain — the closure cell,
+    referenced global, or instance attribute that actually can't cross
+    the task boundary. `cause` (the original pickling error) should be
+    chained by the caller with `raise ... from cause`.
+    """
+    name = name or getattr(obj, "__name__", type(obj).__name__)
+    try:
+        _ok, failures = inspect_serializability(obj, name=name)
+    except Exception:  # graftlint: disable=EXC-SWALLOW (diagnosis is best-effort; the original error still propagates via __cause__)
+        failures = []
+    if failures:
+        def _safe_repr(o) -> str:
+            # The objects that can't pickle are exactly the ones whose
+            # __repr__ tends to blow up too — never let it mask the chain.
+            try:
+                return repr(o)[:120]
+            except Exception:  # graftlint: disable=EXC-SWALLOW (diagnostic formatting must never raise)
+                return f"<{type(o).__name__} (repr failed)>"
+
+        chain = "\n".join(
+            f"  - {f.name!r} (inside {f.parent!r}): "
+            f"{type(f.obj).__name__} = {_safe_repr(f.obj)}"
+            for f in failures[:8]
+        )
+        detail = (f"could not serialize these captured values:\n{chain}\n"
+                  "Pass them as arguments, reconstruct them on the worker, "
+                  "or drop them from the closure.")
+    else:
+        detail = (f"could not localize the failing value "
+                  f"(original error: {cause!r})")
+    return TypeError(f"{kind} {name!r} is not serializable: {detail}")

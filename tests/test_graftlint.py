@@ -1,0 +1,645 @@
+"""graftlint: per-rule true-positive + clean fixtures, suppression,
+baseline semantics, JSON schema, and the check_serialize submit wiring.
+
+Fixtures are written to tmp_path and linted through the real engine
+(same code path as `python -m tools.graftlint`), so rule behavior,
+suppression parsing, and fingerprinting are all exercised end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint.engine import Finding, lint_paths
+from tools.graftlint.rules import ALL_RULES, RULES_BY_ID
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def lint_src(tmp_path: Path, src: str, rules=None, name="fix.py"):
+    f = tmp_path / name
+    f.write_text(src)
+    res = lint_paths([str(f)], rules or ALL_RULES)
+    return res
+
+
+def rule_ids(res):
+    return {f.rule for f in res.findings}
+
+
+# ---------------------------------------------------------------- rules
+
+def test_jit_closure_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+import jax.numpy as jnp
+
+SCALE = jnp.array([1.0, 2.0])
+
+@jax.jit
+def apply(x):
+    return x * SCALE
+""")
+    assert "JIT-CLOSURE" in rule_ids(res)
+
+
+def test_jit_closure_clean_when_passed_as_arg(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+import jax.numpy as jnp
+
+SCALE = jnp.array([1.0, 2.0])
+
+@jax.jit
+def apply(x, scale):
+    return x * scale
+
+def run(x):
+    return apply(x, SCALE)
+""", rules=[RULES_BY_ID["JIT-CLOSURE"]])
+    assert res.findings == []
+
+
+def test_jit_closure_self_attr(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+import jax.numpy as jnp
+
+class Policy:
+    def __init__(self):
+        self.w = jnp.zeros((4, 4))
+        self._fwd = jax.jit(self._fwd_impl)
+
+    def _fwd_impl(self, x):
+        return x @ self.w
+""", rules=[RULES_BY_ID["JIT-CLOSURE"]])
+    assert "JIT-CLOSURE" in rule_ids(res)
+
+
+def test_jit_side_effect_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import time
+import jax
+
+@jax.jit
+def step(x):
+    print("tracing", x)
+    t = time.time()
+    return x + t
+""", rules=[RULES_BY_ID["JIT-SIDE-EFFECT"]])
+    msgs = [f.message for f in res.findings]
+    assert len(res.findings) == 2        # print + time.time
+    assert any("print" in m for m in msgs)
+    assert any("wall-clock" in m for m in msgs)
+
+
+def test_jit_side_effect_clean_with_debug_print(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+@jax.jit
+def step(x):
+    jax.debug.print("x = {}", x)
+    return x + 1
+""", rules=[RULES_BY_ID["JIT-SIDE-EFFECT"]])
+    assert res.findings == []
+
+
+def test_jit_in_loop_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+def train(batches):
+    out = []
+    for b in batches:
+        out.append(jax.jit(lambda x: x + 1)(b))
+    return out
+""", rules=[RULES_BY_ID["JIT-IN-LOOP"]])
+    assert "JIT-IN-LOOP" in rule_ids(res)
+
+
+def test_jit_in_loop_clean_when_hoisted(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+def train(batches):
+    step = jax.jit(lambda x: x + 1)
+    return [step(b) for b in batches]
+""", rules=[RULES_BY_ID["JIT-IN-LOOP"]])
+    assert res.findings == []
+
+
+def test_jit_in_loop_astype_in_traced_fn(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def fwd(x, layers):
+    for w in layers:
+        x = x @ w.astype(jnp.bfloat16)
+    return x
+""", rules=[RULES_BY_ID["JIT-IN-LOOP"]])
+    assert any(".astype" in f.message for f in res.findings)
+
+
+def test_donate_miss_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+@jax.jit
+def train_step(params, opt_state, batch):
+    return params, opt_state
+""", rules=[RULES_BY_ID["DONATE-MISS"]])
+    assert "DONATE-MISS" in rule_ids(res)
+
+
+def test_donate_miss_clean_with_donate(tmp_path):
+    res = lint_src(tmp_path, """\
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def train_step(params, opt_state, batch):
+    return params, opt_state
+""", rules=[RULES_BY_ID["DONATE-MISS"]])
+    assert res.findings == []
+
+
+def test_async_block_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import time
+import ray_tpu
+
+async def handler(req):
+    time.sleep(0.1)
+    return ray_tpu.get(req)
+""", rules=[RULES_BY_ID["ASYNC-BLOCK"]])
+    assert len(res.findings) == 2        # time.sleep + ray_tpu.get
+
+
+def test_async_block_clean_when_offloaded(tmp_path):
+    # Nested sync defs (executor offload pattern) must NOT fire: the
+    # blocking call runs on a pool thread, not the loop.
+    res = lint_src(tmp_path, """\
+import asyncio
+import time
+import ray_tpu
+
+async def handler(loop, pool, ref):
+    await asyncio.sleep(0.1)
+    return await loop.run_in_executor(pool, lambda: ray_tpu.get(ref))
+
+async def poller():
+    def blocking_probe():
+        time.sleep(1.0)
+    await asyncio.to_thread(blocking_probe)
+""", rules=[RULES_BY_ID["ASYNC-BLOCK"]])
+    assert res.findings == []
+
+
+def test_host_sync_in_hot_loop_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import numpy as np
+
+def decode_tokens(engine, n):
+    toks = []
+    while len(toks) < n:
+        logits = engine.forward()
+        toks.append(np.asarray(logits).argmax())
+    return toks
+""", rules=[RULES_BY_ID["HOST-SYNC-IN-HOT-LOOP"]])
+    assert "HOST-SYNC-IN-HOT-LOOP" in rule_ids(res)
+
+
+def test_host_sync_clean_outside_hot_fn(tmp_path):
+    res = lint_src(tmp_path, """\
+import numpy as np
+
+def collect(engine, n):
+    vals = []
+    for _ in range(n):
+        vals.append(np.asarray(engine.forward()))
+    return vals
+
+def decode_tokens(engine, n):
+    device_toks = engine.forward_n(n)
+    return np.asarray(device_toks)
+""", rules=[RULES_BY_ID["HOST-SYNC-IN-HOT-LOOP"]])
+    assert res.findings == []
+
+
+def test_exc_swallow_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+def probe(conn):
+    try:
+        return conn.call()
+    except Exception:
+        return None
+""", rules=[RULES_BY_ID["EXC-SWALLOW"]])
+    assert "EXC-SWALLOW" in rule_ids(res)
+
+
+def test_exc_swallow_clean_when_logged_raised_or_used(tmp_path):
+    res = lint_src(tmp_path, """\
+import logging
+
+logger = logging.getLogger(__name__)
+
+def a(conn):
+    try:
+        return conn.call()
+    except Exception as e:
+        logger.warning("call failed: %s", e)
+        return None
+
+def b(conn):
+    try:
+        return conn.call()
+    except Exception:
+        raise RuntimeError("call failed")
+
+def c(conn, fut):
+    try:
+        return conn.call()
+    except Exception as e:
+        fut.set_exception(e)
+
+def d(conn):
+    try:
+        return conn.call()
+    except ValueError:
+        return None
+""", rules=[RULES_BY_ID["EXC-SWALLOW"]])
+    assert res.findings == []
+
+
+def test_ser_capture_fires_direct_arg(tmp_path):
+    res = lint_src(tmp_path, """\
+import threading
+
+def submit(actor):
+    lock = threading.Lock()
+    return actor.run.remote(lock)
+""", rules=[RULES_BY_ID["SER-CAPTURE"]])
+    assert "SER-CAPTURE" in rule_ids(res)
+
+
+def test_ser_capture_fires_via_closure(tmp_path):
+    res = lint_src(tmp_path, """\
+import threading
+import ray_tpu
+
+def submit(remote_fn):
+    lock = threading.Lock()
+
+    def work(x):
+        with lock:
+            return x + 1
+
+    return remote_fn.remote(work)
+""", rules=[RULES_BY_ID["SER-CAPTURE"]])
+    assert any("closes over" in f.message for f in res.findings)
+
+
+def test_ser_capture_clean(tmp_path):
+    res = lint_src(tmp_path, """\
+import threading
+
+def submit(actor, payload):
+    lock = threading.Lock()      # local coordination only, never shipped
+    with lock:
+        return actor.run.remote(payload)
+
+def sibling_scopes(actor):
+    # A lock in one function must not taint another function's submit.
+    return actor.run.remote(42)
+""", rules=[RULES_BY_ID["SER-CAPTURE"]])
+    assert res.findings == []
+
+
+# --------------------------------------------------- engine semantics
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    res = lint_src(tmp_path, """\
+def a(conn):
+    try:
+        return conn.call()
+    except Exception:  # graftlint: disable=EXC-SWALLOW (probe contract)
+        return None
+
+def b(conn):
+    try:
+        return conn.call()
+    # graftlint: disable=EXC-SWALLOW
+    except Exception:
+        return None
+
+def c(conn):
+    try:
+        return conn.call()
+    except Exception:  # graftlint: disable=JIT-CLOSURE (wrong rule: must NOT suppress)
+        return None
+
+def d(conn):
+    try:
+        return conn.call()
+    except Exception:  # graftlint: disable=EXC-SWALLOW because shutdown races
+        return None
+""", rules=[RULES_BY_ID["EXC-SWALLOW"]])
+    # a, b, and d (unparenthesized justification) suppress; c does not
+    assert res.suppressed == 3
+    assert len(res.findings) == 1
+    assert res.findings[0].line > 10     # only c()'s handler survives
+
+
+def test_baseline_old_tolerated_new_fails(tmp_path):
+    src_v1 = """\
+def a(conn):
+    try:
+        return conn.call()
+    except Exception:
+        return None
+"""
+    f = tmp_path / "mod.py"
+    f.write_text(src_v1)
+    res1 = lint_paths([str(f)], [RULES_BY_ID["EXC-SWALLOW"]])
+    assert len(res1.findings) == 1
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write(res1.findings, bl)
+
+    # Same finding, shifted lines: still baselined (fingerprint is
+    # content-based, not line-based).
+    f.write_text("import os\n\n\n" + src_v1)
+    res2 = lint_paths([str(f)], [RULES_BY_ID["EXC-SWALLOW"]],
+                      baseline_mod.load(bl))
+    assert len(res2.findings) == 1 and res2.findings[0].baselined
+    assert res2.new_findings == []
+
+    # A NEW swallow is not grandfathered.
+    f.write_text(src_v1 + """\
+
+def b(conn):
+    try:
+        return conn.ping()
+    except Exception:
+        return False
+""")
+    res3 = lint_paths([str(f)], [RULES_BY_ID["EXC-SWALLOW"]],
+                      baseline_mod.load(bl))
+    assert len(res3.findings) == 2
+    assert len(res3.new_findings) == 1
+
+
+def test_baseline_missing_file_degrades_to_empty(tmp_path):
+    assert baseline_mod.load(tmp_path / "nope.json") == {}
+    (tmp_path / "corrupt.json").write_text("{not json")
+    assert baseline_mod.load(tmp_path / "corrupt.json") == {}
+
+
+def test_baseline_identical_lines_tolerate_fixing_one(tmp_path):
+    # Two byte-identical findings share a fingerprint with count 2;
+    # fixing ONE must not make the survivor read as "new" (the
+    # occurrence-shift churn a content fingerprint exists to avoid).
+    handler = """\
+    try:
+        return conn.call()
+    except Exception:
+        return None
+"""
+    f = tmp_path / "mod.py"
+    f.write_text(f"def a(conn):\n{handler}\n\ndef b(conn):\n{handler}")
+    res1 = lint_paths([str(f)], [RULES_BY_ID["EXC-SWALLOW"]])
+    assert len(res1.findings) == 2
+    assert res1.findings[0].fingerprint == res1.findings[1].fingerprint
+    bl = tmp_path / "bl.json"
+    baseline_mod.write(res1.findings, bl)
+    assert baseline_mod.load(bl) == {res1.findings[0].fingerprint: 2}
+
+    f.write_text(f"def a(conn):\n    return conn.call()\n\n"
+                 f"def b(conn):\n{handler}")
+    res2 = lint_paths([str(f)], [RULES_BY_ID["EXC-SWALLOW"]],
+                      baseline_mod.load(bl))
+    assert res2.new_findings == []
+
+    # ...but a THIRD identical swallow beyond the tolerated count is new.
+    f.write_text(f"def a(conn):\n{handler}\n\ndef b(conn):\n{handler}\n\n"
+                 f"def c(conn):\n{handler}")
+    res3 = lint_paths([str(f)], [RULES_BY_ID["EXC-SWALLOW"]],
+                      baseline_mod.load(bl))
+    assert len(res3.new_findings) == 1
+
+
+def test_paths_normalized_repo_relative():
+    # Absolute and relative invocations must agree on path + fingerprint,
+    # or a baseline written one way never matches CI running the other
+    # way (and the core/serve no-grandfather check could be bypassed).
+    rel = lint_paths(["ray_tpu/utils/rpdb.py"],
+                     [RULES_BY_ID["EXC-SWALLOW"]])
+    absolute = lint_paths([str(REPO_ROOT / "ray_tpu/utils/rpdb.py")],
+                          [RULES_BY_ID["EXC-SWALLOW"]])
+    assert [f.path for f in rel.findings] == \
+        [f.path for f in absolute.findings]
+    assert rel.findings and rel.findings[0].path == "ray_tpu/utils/rpdb.py"
+    assert [f.fingerprint for f in rel.findings] == \
+        [f.fingerprint for f in absolute.findings]
+
+
+def test_write_baseline_preserves_unscanned_files(tmp_path):
+    src = """\
+def a(conn):
+    try:
+        return conn.call()
+    except Exception:
+        return None
+"""
+    f1, f2 = tmp_path / "one.py", tmp_path / "two.py"
+    f1.write_text(src)
+    f2.write_text(src)
+    bl = tmp_path / "bl.json"
+    res_all = lint_paths([str(f1), str(f2)], [RULES_BY_ID["EXC-SWALLOW"]])
+    baseline_mod.write(res_all.findings, bl,
+                       scanned_files=res_all.scanned_files)
+    assert len(baseline_mod.load_entries(bl)) == 2
+
+    # Re-writing from a scan of ONLY f1 must keep f2's entry...
+    res_one = lint_paths([str(f1)], [RULES_BY_ID["EXC-SWALLOW"]])
+    baseline_mod.write(res_one.findings, bl,
+                       scanned_files=res_one.scanned_files)
+    assert len(baseline_mod.load_entries(bl)) == 2
+
+    # ...while a scanned-and-now-clean file has its stale entry dropped.
+    f1.write_text("def a(conn):\n    return conn.call()\n")
+    res_clean = lint_paths([str(f1)], [RULES_BY_ID["EXC-SWALLOW"]])
+    baseline_mod.write(res_clean.findings, bl,
+                       scanned_files=res_clean.scanned_files)
+    entries = baseline_mod.load_entries(bl)
+    assert len(entries) == 1 and entries[0]["path"].endswith("two.py")
+
+
+def test_baseline_refuses_core_and_serve_paths(tmp_path):
+    findings = [
+        Finding(rule="EXC-SWALLOW", path="ray_tpu/core/client.py",
+                line=1, col=0, message="m", fingerprint="aa"),
+        Finding(rule="EXC-SWALLOW", path="ray_tpu/serve/api.py",
+                line=1, col=0, message="m", fingerprint="bb"),
+        Finding(rule="EXC-SWALLOW", path="ray_tpu/rllib/es.py",
+                line=1, col=0, message="m", fingerprint="cc"),
+    ]
+    bl = tmp_path / "bl.json"
+    written, refused = baseline_mod.write(findings, bl)
+    assert written == 1
+    assert {f.path for f in refused} == {
+        "ray_tpu/core/client.py", "ray_tpu/serve/api.py"}
+    assert baseline_mod.load(bl) == {"cc": 1}
+
+
+# ------------------------------------------------------------- CLI
+
+def _run_cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        capture_output=True, text=True, cwd=cwd)
+
+
+def test_cli_json_schema_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("""\
+def a(conn):
+    try:
+        return conn.call()
+    except Exception:
+        return None
+""")
+    p = _run_cli(str(bad), "--no-baseline", "--json")
+    assert p.returncode == 1
+    doc = json.loads(p.stdout)
+    assert doc["version"] == 1
+    assert doc["new_count"] == 1
+    (finding,) = doc["findings"]
+    assert set(finding) == {"rule", "path", "line", "col", "message",
+                            "fingerprint", "baselined"}
+    assert finding["rule"] == "EXC-SWALLOW"
+    assert finding["line"] == 4
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    p = _run_cli(str(clean), "--no-baseline")
+    assert p.returncode == 0
+
+    p = _run_cli(str(tmp_path / "syntax_err.py"))
+    # Missing file/parse problems are usage errors, not findings.
+    (tmp_path / "syntax_err.py").write_text("def (:\n")
+    p = _run_cli(str(tmp_path / "syntax_err.py"))
+    assert p.returncode == 2
+
+
+def test_cli_select_unknown_rule_errors():
+    p = _run_cli("--select", "NOT-A-RULE", "tools/")
+    assert p.returncode == 2
+    assert "unknown rule" in p.stderr
+
+
+def test_cli_write_baseline_rejects_select():
+    p = _run_cli("ray_tpu/", "--select", "EXC-SWALLOW", "--write-baseline")
+    assert p.returncode == 2
+    assert "--select" in p.stderr
+
+
+def test_cli_empty_scan_is_usage_error(tmp_path):
+    p = _run_cli(str(tmp_path / "no_such_dir"))
+    assert p.returncode == 2
+    assert "no Python files" in p.stderr
+
+
+def test_cli_write_baseline_refuses_parse_errors(tmp_path):
+    # An unparseable file has unknown findings: rewriting the baseline
+    # around it would silently purge its grandfathered entries.
+    f = tmp_path / "a.py"
+    f.write_text("""\
+def a(conn):
+    try:
+        return conn.call()
+    except Exception:
+        return None
+""")
+    bl = tmp_path / "bl.json"
+    p = _run_cli(str(tmp_path), "--baseline", str(bl), "--write-baseline")
+    assert p.returncode == 0
+    assert len(baseline_mod.load_entries(bl)) == 1
+
+    f.write_text("def (:\n")
+    p = _run_cli(str(tmp_path), "--baseline", str(bl), "--write-baseline")
+    assert p.returncode == 2
+    assert "refusing --write-baseline" in p.stderr
+    assert len(baseline_mod.load_entries(bl)) == 1   # entry survived
+
+
+@pytest.mark.slow
+def test_repo_tree_is_clean_against_baseline():
+    # The acceptance gate ci.sh enforces; here as a slow-tier cross-check.
+    p = _run_cli("ray_tpu/")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+# ------------------------------------- check_serialize submit wiring
+
+def test_remote_function_pickle_error_is_localized():
+    import threading
+
+    import ray_tpu
+
+    lock = threading.Lock()
+
+    @ray_tpu.remote
+    def f():
+        return lock.locked()
+
+    with pytest.raises(TypeError) as ei:
+        f._blob()       # the .remote() submit path's first step, no cluster
+    msg = str(ei.value)
+    assert "'lock'" in msg and "not serializable" in msg
+    assert ei.value.__cause__ is not None
+
+
+def test_actor_class_pickle_error_is_localized():
+    # NB a file handle is NOT the fixture here: cloudpickle >= 3.1
+    # silently snapshots open files as StringIO. Locks still hard-fail.
+    import threading
+
+    import ray_tpu
+
+    guard = threading.Lock()
+
+    @ray_tpu.remote
+    class A:
+        def __init__(self):
+            self.guard = guard
+
+    with pytest.raises(TypeError) as ei:
+        A._blob()
+    assert "not serializable" in str(ei.value)
+
+
+def test_serialization_error_helper_reports_chain():
+    import socket
+
+    from ray_tpu.utils.check_serialize import serialization_error
+
+    s = socket.socket()
+    try:
+        def g():
+            return s.fileno()
+
+        err = serialization_error(g, name="g", kind="remote function",
+                                  cause=TypeError("boom"))
+        assert isinstance(err, TypeError)
+        assert "'s'" in str(err)
+    finally:
+        s.close()

@@ -1,0 +1,25 @@
+"""graftlint — AST static analysis for the hazards that hurt this stack.
+
+pytest can't see a jitted function constant-folding a closed-over array, a
+`time.sleep` stalling the Serve proxy's event loop, or an `except Exception`
+swallowing a control-plane failure into a hang — they only fire under load.
+graftlint catches them at commit time.
+
+Usage:
+    python -m tools.graftlint ray_tpu/            # lint against the baseline
+    python -m tools.graftlint --list-rules
+    python -m tools.graftlint ray_tpu/ --json
+    python -m tools.graftlint ray_tpu/ --write-baseline
+
+Suppression:  # graftlint: disable=RULE-ID[,RULE-ID]  (same line, or the
+comment-only line directly above). `disable=all` silences every rule.
+
+Baseline: `tools/graftlint/baseline.json` holds fingerprints of
+grandfathered findings; old findings are tolerated, new ones fail the run.
+Policy: findings under ray_tpu/core/ and ray_tpu/serve/ must be fixed or
+carry a justified inline suppression — never baselined.
+"""
+
+from tools.graftlint.engine import Finding, LintResult, lint_paths  # noqa: F401
+
+__all__ = ["Finding", "LintResult", "lint_paths"]

@@ -1,0 +1,124 @@
+"""CLI. Exit codes: 0 clean (or everything baselined/suppressed),
+1 new findings, 2 usage/parse error."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint.engine import lint_paths
+from tools.graftlint.rules import ALL_RULES, RULES_BY_ID
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST static analysis for JAX-boundary, event-loop, "
+                    "and exception-hygiene hazards.")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file (default: tools/graftlint/"
+                         "baseline.json; missing file = empty baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding is new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather current findings (refuses "
+                         "ray_tpu/core/ and ray_tpu/serve/ paths)")
+    ap.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print grandfathered findings (default: "
+                         "only new ones, plus the summary line)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id:24s} {r.summary}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("error: no paths given", file=sys.stderr)
+        return 2
+
+    rules = ALL_RULES
+    if args.select:
+        ids = [s.strip().upper() for s in args.select.split(",") if s.strip()]
+        unknown = [i for i in ids if i not in RULES_BY_ID]
+        if unknown:
+            print(f"error: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [RULES_BY_ID[i] for i in ids]
+
+    if args.write_baseline and args.select:
+        # A rule-filtered scan would rewrite the file without every other
+        # rule's entries — regenerate from a full-rule run instead.
+        print("error: --write-baseline cannot be combined with --select",
+              file=sys.stderr)
+        return 2
+
+    counts: dict[str, int] = {}
+    if not args.no_baseline and not args.write_baseline:
+        counts = baseline_mod.load(args.baseline)
+
+    result = lint_paths(args.paths, rules, counts)
+
+    if not result.scanned_files and not result.parse_errors:
+        print(f"error: no Python files found under: {' '.join(args.paths)}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if result.parse_errors:
+            # A file we couldn't parse has unknown findings — rewriting
+            # the baseline around it would silently drop its entries.
+            for e in result.parse_errors:
+                print(f"PARSE ERROR {e}", file=sys.stderr)
+            print("error: refusing --write-baseline with parse errors",
+                  file=sys.stderr)
+            return 2
+        written, refused = baseline_mod.write(
+            result.findings, args.baseline,
+            scanned_files=result.scanned_files)
+        print(f"baseline: wrote {written} finding(s)")
+        if refused:
+            print(f"REFUSED to baseline {len(refused)} finding(s) under "
+                  f"{', '.join(baseline_mod.NO_GRANDFATHER_PREFIXES)} — "
+                  "fix or inline-suppress them:", file=sys.stderr)
+            for f in refused:
+                print(f"  {f.render()}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.to_json() for f in result.findings],
+            "suppressed": result.suppressed,
+            "parse_errors": result.parse_errors,
+            "new_count": len(result.new_findings),
+        }, indent=1))
+    else:
+        for f in result.findings:
+            if args.show_baselined or not f.baselined:
+                print(f.render())
+        for e in result.parse_errors:
+            print(f"PARSE ERROR {e}", file=sys.stderr)
+        n_base = sum(1 for f in result.findings if f.baselined)
+        print(f"graftlint: {len(result.findings)} finding(s) "
+              f"({n_base} baselined, {result.suppressed} suppressed, "
+              f"{len(result.new_findings)} new)")
+
+    if result.parse_errors:
+        return 2
+    return 1 if result.new_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

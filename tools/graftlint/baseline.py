@@ -1,0 +1,75 @@
+"""Committed baseline: grandfathered findings tolerated by fingerprint.
+
+Schema (version 1):
+    {"version": 1,
+     "findings": [{"fingerprint": ..., "rule": ..., "path": ...,
+                   "message": ...}, ...]}
+
+Fingerprints are content-based (path, rule, line text) with NO occurrence
+index; duplicate entries encode "N findings with this identity are
+tolerated". `load` returns that fingerprint → count mapping and degrades
+gracefully: a missing or unreadable baseline is an empty one (every
+finding is "new"), so a fresh checkout still lints — it just holds the
+whole tree to zero.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
+
+# Findings under these prefixes must be FIXED or inline-suppressed with a
+# justification — writing them into the baseline is refused (the hot
+# control/data planes don't get to grandfather hazards). Paths are
+# repo-relative (engine.normalize_path), so the check holds regardless of
+# cwd or absolute-path invocation.
+NO_GRANDFATHER_PREFIXES = ("ray_tpu/core/", "ray_tpu/serve/")
+
+
+def load_entries(path: Path | str | None = None) -> list[dict]:
+    p = Path(path) if path is not None else DEFAULT_BASELINE
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return []
+    return [f for f in data.get("findings", [])
+            if isinstance(f, dict) and "fingerprint" in f]
+
+
+def load(path: Path | str | None = None) -> dict[str, int]:
+    """fingerprint → tolerated count."""
+    return dict(Counter(f["fingerprint"] for f in load_entries(path)))
+
+
+def write(findings, path: Path | str | None = None,
+          scanned_files: list[str] | None = None) -> tuple[int, list]:
+    """Write the baseline from current findings, PRESERVING existing
+    entries for files outside this scan (a partial-path run must not
+    silently drop the rest of the tree's grandfathered findings). Pass
+    `scanned_files` (LintResult.scanned_files) so files that were scanned
+    and came back clean have their stale entries dropped.
+    Returns (entries_written, refused) where `refused` is the
+    no-grandfather findings left OUT — they must be fixed or suppressed."""
+    p = Path(path) if path is not None else DEFAULT_BASELINE
+    scanned = (set(scanned_files) if scanned_files is not None
+               else {f.path for f in findings})
+    keep = [e for e in load_entries(p) if e.get("path") not in scanned]
+    allowed, refused = [], []
+    for f in findings:
+        if f.path.startswith(NO_GRANDFATHER_PREFIXES):
+            refused.append(f)
+        else:
+            allowed.append(
+                {"fingerprint": f.fingerprint, "rule": f.rule,
+                 "path": f.path, "message": f.message, "_line": f.line})
+    merged = keep + allowed
+    merged.sort(key=lambda e: (e.get("path", ""), e.get("_line", 0),
+                               e.get("rule", "")))
+    for e in merged:
+        e.pop("_line", None)
+    payload = {"version": 1, "findings": merged}
+    p.write_text(json.dumps(payload, indent=1) + "\n", encoding="utf-8")
+    return len(merged), refused

@@ -1,0 +1,200 @@
+"""Rule engine: file walking, AST parse, suppression comments, fingerprints.
+
+A rule is a small class with an `id`, a one-line `summary`, and a
+`check(ctx) -> list[Finding]` that walks `ctx.tree`. The engine owns
+everything else: which files run, which findings are suppressed inline,
+and the stable fingerprint each finding carries into the baseline.
+
+Fingerprints hash (path, rule, stripped source line, occurrence index) —
+NOT the line number — so a baseline survives unrelated edits that shift
+lines, but a finding moved to a *new* piece of code re-fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from pathlib import Path
+from typing import Iterable
+
+# Rule tokens only — no bare \s in the class, or an unparenthesized
+# justification ("disable=EXC-SWALLOW because shutdown") would be globbed
+# into the rule id and the suppression would silently not take.
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable="
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+
+SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+# Finding paths are normalized repo-relative whenever the file lives under
+# this repo, so fingerprints and the no-grandfather policy behave the same
+# from any cwd or with absolute path arguments.
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def normalize_path(f: Path) -> str:
+    try:
+        return f.resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return f.as_posix()
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str            # posix, relative to the lint root's cwd
+    line: int            # 1-based
+    col: int             # 0-based
+    message: str
+    fingerprint: str = ""
+    baselined: bool = False
+
+    def render(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return f"{self.path}:{self.line}:{self.col} {self.rule} {self.message}{tag}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "baselined": self.baselined,
+        }
+
+
+class FileContext:
+    """Everything a rule gets to look at for one file."""
+
+    def __init__(self, path: str, src: str, tree: ast.AST):
+        self.path = path
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = tree
+        self.cache: dict = {}     # per-file scratch shared across rules
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+class Rule:
+    id: str = ""
+    summary: str = ""
+
+    def applies_to(self, path: str) -> bool:  # pragma: no cover - trivial
+        return True
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        raise NotImplementedError
+
+
+def _suppressed_rules_for_line(lines: list[str], lineno: int) -> set[str]:
+    """Union of disables on the finding's own line and, if the physical line
+    above is comment-only, that line too (lets long statements carry the
+    marker without blowing line length)."""
+    out: set[str] = set()
+    for idx in (lineno - 1, lineno - 2):
+        if idx < 0 or idx >= len(lines):
+            continue
+        text = lines[idx]
+        if idx == lineno - 2 and not text.lstrip().startswith("#"):
+            continue
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out |= {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def iter_python_files(paths: Iterable[str]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            for f in sorted(pp.rglob("*.py")):
+                if not any(part in SKIP_DIRS for part in f.parts):
+                    files.append(f)
+        elif pp.suffix == ".py":
+            files.append(pp)
+    return files
+
+
+def _fingerprint(path: str, rule: str, line_text: str) -> str:
+    """Content-based identity: (path, rule, stripped line text). NO line
+    number and NO occurrence index — the baseline stores a tolerated COUNT
+    per fingerprint instead, so fixing one of N identical findings doesn't
+    churn the survivors' identities."""
+    key = f"{path}|{rule}|{line_text.strip()}"
+    return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]          # post-suppression, fingerprinted
+    suppressed: int
+    parse_errors: list[str]
+    scanned_files: list[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def new_findings(self) -> list[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+
+def lint_paths(
+    paths: Iterable[str],
+    rules: list[Rule],
+    baseline_counts: dict[str, int] | None = None,
+) -> LintResult:
+    baseline_counts = baseline_counts or {}
+    findings: list[Finding] = []
+    suppressed = 0
+    parse_errors: list[str] = []
+    scanned: list[str] = []
+
+    for f in iter_python_files(paths):
+        path = normalize_path(f)
+        try:
+            src = f.read_text(encoding="utf-8")
+            tree = ast.parse(src, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # NOT added to scanned_files: an unparseable file has unknown
+            # findings — baseline.write must not treat it as "now clean".
+            parse_errors.append(f"{path}: {e}")
+            continue
+        scanned.append(path)
+        ctx = FileContext(path, src, tree)
+        per_file: list[Finding] = []
+        for rule in rules:
+            if not rule.applies_to(path):
+                continue
+            per_file.extend(rule.check(ctx))
+        kept: list[Finding] = []
+        for fd in sorted(per_file, key=lambda x: (x.line, x.col, x.rule)):
+            sup = _suppressed_rules_for_line(ctx.lines, fd.line)
+            if "ALL" in sup or fd.rule.upper() in sup:
+                suppressed += 1
+                continue
+            kept.append(fd)
+        # First `count` findings per fingerprint (file order) are tolerated;
+        # identical lines beyond the baselined count are new.
+        used: dict[str, int] = {}
+        for fd in kept:
+            text = ctx.lines[fd.line - 1] if fd.line - 1 < len(ctx.lines) else ""
+            fd.fingerprint = _fingerprint(path, fd.rule, text)
+            n = used.get(fd.fingerprint, 0)
+            used[fd.fingerprint] = n + 1
+            fd.baselined = n < baseline_counts.get(fd.fingerprint, 0)
+        findings.extend(kept)
+
+    return LintResult(findings=findings, suppressed=suppressed,
+                      parse_errors=parse_errors, scanned_files=scanned)

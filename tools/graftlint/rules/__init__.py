@@ -1,0 +1,28 @@
+"""Rule registry. Each rule targets a failure mode this codebase has
+actually hit (see ISSUE/PR history): silent constant-folds, per-step
+re-lowers, blocked event loops, swallowed control-plane failures,
+unpicklable `.remote()` captures."""
+
+from tools.graftlint.rules.asyncio_rules import AsyncBlockRule
+from tools.graftlint.rules.exceptions import ExcSwallowRule
+from tools.graftlint.rules.jit import (
+    DonateMissRule,
+    HostSyncInHotLoopRule,
+    JitClosureRule,
+    JitInLoopRule,
+    JitSideEffectRule,
+)
+from tools.graftlint.rules.serialize import SerCaptureRule
+
+ALL_RULES = [
+    JitClosureRule(),
+    JitSideEffectRule(),
+    JitInLoopRule(),
+    DonateMissRule(),
+    AsyncBlockRule(),
+    HostSyncInHotLoopRule(),
+    ExcSwallowRule(),
+    SerCaptureRule(),
+]
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

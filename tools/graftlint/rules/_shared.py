@@ -1,0 +1,187 @@
+"""AST helpers shared across rules: dotted names, jit discovery, scopes."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+# Method names that count as "this handler/function logged something" —
+# shared by EXC-SWALLOW (what absolves a broad handler) and
+# JIT-SIDE-EFFECT (what must not run under trace), so the two rules can
+# never drift on what logging is.
+LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+               "critical", "log"}
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`jax.numpy.asarray` → "jax.numpy.asarray"; None for non-name chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# Callables that enter a traced context when applied to a function.
+_JIT_NAMES = {"jit", "pjit"}
+_JIT_ATTRS = {"jit", "pjit", "shard_map"}
+
+
+def is_jit_callable(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_ATTRS
+    return False
+
+
+def jit_call_parts(call: ast.Call) -> tuple[ast.AST | None, list[ast.keyword]]:
+    """If `call` applies a jit wrapper, return (wrapped_expr, keywords);
+    else (None, []). Handles `jax.jit(f, ...)` and
+    `functools.partial(jax.jit, ...)` (wrapped_expr None for the latter —
+    the partial form wraps via decorator, keywords still carry donate)."""
+    if is_jit_callable(call.func):
+        target = call.args[0] if call.args else None
+        return target, call.keywords
+    d = dotted(call.func)
+    if d in ("functools.partial", "partial") and call.args \
+            and is_jit_callable(call.args[0]):
+        target = call.args[1] if len(call.args) > 1 else None
+        return target, call.keywords
+    return None, []
+
+
+def is_jit_construction(call: ast.Call) -> bool:
+    """True when evaluating `call` builds a new jitted callable."""
+    if is_jit_callable(call.func):
+        return True
+    d = dotted(call.func)
+    return d in ("functools.partial", "partial") and bool(call.args) \
+        and is_jit_callable(call.args[0])
+
+
+def _decorator_jit_keywords(dec: ast.AST) -> list[ast.keyword] | None:
+    """None if `dec` is not a jit decorator, else its keywords."""
+    if is_jit_callable(dec):
+        return []
+    if isinstance(dec, ast.Call):
+        if is_jit_callable(dec.func):
+            return dec.keywords
+        d = dotted(dec.func)
+        if d in ("functools.partial", "partial") and dec.args \
+                and is_jit_callable(dec.args[0]):
+            return dec.keywords
+    return None
+
+
+@dataclasses.dataclass
+class JittedFn:
+    node: ast.FunctionDef | ast.Lambda
+    name: str
+    donate: bool
+    site: ast.AST                 # where jit was applied (for line numbers)
+    owner_class: ast.ClassDef | None
+
+
+def _has_donate(keywords: list[ast.keyword]) -> bool:
+    return any(k.arg in ("donate_argnums", "donate_argnames")
+               for k in keywords)
+
+
+def collect_jitted(tree: ast.AST) -> list[JittedFn]:
+    """All function bodies that run under trace: decorator-jitted defs,
+    defs passed by name to a jit call anywhere in the file, and lambdas
+    passed inline."""
+    defs: dict[str, list[tuple[ast.FunctionDef, ast.ClassDef | None]]] = {}
+
+    class DefCollector(ast.NodeVisitor):
+        def __init__(self):
+            self.cls: list[ast.ClassDef] = []
+
+        def visit_ClassDef(self, node):
+            self.cls.append(node)
+            self.generic_visit(node)
+            self.cls.pop()
+
+        def _add(self, node):
+            owner = self.cls[-1] if self.cls else None
+            defs.setdefault(node.name, []).append((node, owner))
+            self.generic_visit(node)
+
+        visit_FunctionDef = _add
+        visit_AsyncFunctionDef = _add
+
+    DefCollector().visit(tree)
+
+    out: list[JittedFn] = []
+    seen: set[int] = set()
+
+    def add(node, name, donate, site, owner):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        out.append(JittedFn(node, name, donate, site, owner))
+
+    for name, entries in defs.items():
+        for fn, owner in entries:
+            for dec in getattr(fn, "decorator_list", []):
+                kws = _decorator_jit_keywords(dec)
+                if kws is not None:
+                    add(fn, name, _has_donate(kws), fn, owner)
+
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        target, kws = jit_call_parts(call)
+        if target is None:
+            continue
+        donate = _has_donate(kws)
+        if isinstance(target, ast.Lambda):
+            add(target, "<lambda>", donate, call, None)
+        elif isinstance(target, ast.Name) and target.id in defs:
+            for fn, owner in defs[target.id]:
+                add(fn, target.id, donate, call, owner)
+        elif isinstance(target, ast.Attribute):
+            # self._update_impl / module.fn — resolve by trailing attr.
+            if target.attr in defs:
+                for fn, owner in defs[target.attr]:
+                    add(fn, target.attr, donate, call, owner)
+
+    return out
+
+
+def bound_names(fn: ast.FunctionDef | ast.Lambda) -> set[str]:
+    """Params plus every name stored anywhere in the body — the
+    conservative 'not a closure capture' set."""
+    args = fn.args
+    names = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store,
+                                                                ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                names.add(node.name)
+    return names
+
+
+def free_names(fn: ast.FunctionDef | ast.Lambda) -> set[str]:
+    """Name loads in `fn` not bound by it — its closure surface."""
+    loads = {n.id for n in ast.walk(fn)
+             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+    return loads - bound_names(fn)
+
+
+def collect_jitted_cached(ctx) -> list[JittedFn]:
+    """Per-file memo of collect_jitted — four rules share the walk."""
+    if "jitted" not in ctx.cache:
+        ctx.cache["jitted"] = collect_jitted(ctx.tree)
+    return ctx.cache["jitted"]

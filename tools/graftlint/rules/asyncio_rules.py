@@ -1,0 +1,102 @@
+"""ASYNC-BLOCK: a blocking call whose *nearest enclosing function* is an
+`async def` stalls that function's whole event loop — in the Serve proxy
+that is every in-flight request on the node (tf.data-service-style
+disaggregated serving dies on exactly this). Calls inside nested sync
+defs are NOT flagged: those run on whatever thread invokes them (the
+to_thread / run_in_executor offload pattern).
+
+Known false negatives (documented, deliberate): `queue.Queue.get()`,
+`Event.wait()`, and socket method calls are syntactically identical to
+innocent `.get()`/`.wait()` on dicts/asyncio primitives — a name-based
+lint cannot split them. The curated list below is the set with an
+unambiguous spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import FileContext, Finding, Rule
+from tools.graftlint.rules._shared import dotted
+
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep blocks the loop — use `await asyncio.sleep`",
+    "ray.get": "blocking get on the loop — await the future form or "
+               "offload via run_in_executor",
+    "ray.wait": "blocking wait on the loop — offload via run_in_executor",
+    "ray_tpu.get": "blocking get on the loop — await the future form or "
+                   "offload via run_in_executor",
+    "ray_tpu.wait": "blocking wait on the loop — offload via "
+                    "run_in_executor",
+    "os.system": "subprocess blocks the loop — use "
+                 "asyncio.create_subprocess_shell",
+    "subprocess.run": "subprocess blocks the loop — use "
+                      "asyncio.create_subprocess_exec",
+    "subprocess.call": "subprocess blocks the loop — use "
+                       "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "subprocess blocks the loop — use "
+                               "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "subprocess blocks the loop — use "
+                             "asyncio.create_subprocess_exec",
+    "requests.get": "synchronous HTTP blocks the loop",
+    "requests.post": "synchronous HTTP blocks the loop",
+    "requests.put": "synchronous HTTP blocks the loop",
+    "requests.delete": "synchronous HTTP blocks the loop",
+    "requests.request": "synchronous HTTP blocks the loop",
+    "socket.create_connection": "blocking connect on the loop — use "
+                                "asyncio.open_connection",
+    "urllib.request.urlopen": "synchronous HTTP blocks the loop",
+}
+
+
+class AsyncBlockRule(Rule):
+    id = "ASYNC-BLOCK"
+    summary = ("blocking call directly inside an `async def` stalls the "
+               "event loop (and every other coroutine on it)")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        rule_id = self.id
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: list[bool] = []   # True = async frame
+
+            def visit_AsyncFunctionDef(self, node):
+                self.stack.append(True)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            def _sync(self, node):
+                self.stack.append(False)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_FunctionDef = _sync
+            visit_Lambda = _sync
+
+            def visit_Call(self, node):
+                if self.stack and self.stack[-1]:
+                    d = dotted(node.func)
+                    if d in _BLOCKING_DOTTED:
+                        out.append(ctx.finding(
+                            rule_id, node,
+                            f"{d}() in async def: "
+                            f"{_BLOCKING_DOTTED[d]}"))
+                    elif isinstance(node.func, ast.Name) \
+                            and node.func.id == "urlopen":
+                        out.append(ctx.finding(
+                            rule_id, node,
+                            "urlopen() in async def: synchronous HTTP "
+                            "blocks the loop"))
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "result":
+                        out.append(ctx.finding(
+                            rule_id, node,
+                            ".result() in async def blocks the loop until "
+                            "the future resolves — `await "
+                            "asyncio.wrap_future(...)` instead"))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return out

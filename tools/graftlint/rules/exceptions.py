@@ -1,0 +1,66 @@
+"""EXC-SWALLOW: a broad handler (`except Exception`, `except
+BaseException`, bare `except:`) whose body neither re-raises, nor logs,
+nor even *reads* the caught exception turns a real control-plane failure
+into a silent hang — the caller keeps waiting on a result that will
+never arrive. This tree had 94 such sites when the rule landed.
+
+A handler passes if any of these appear in its body:
+  - a `raise`
+  - a logging-ish call (logger.*/logging.* level methods, print,
+    warnings.warn, traceback.print_exc)
+  - any read of the bound exception name (it flowed somewhere — into a
+    TaskError, an error payload, a future's set_exception)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import FileContext, Finding, Rule
+from tools.graftlint.rules._shared import LOG_METHODS, dotted
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_loggingish(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "print":
+        return True
+    if isinstance(f, ast.Attribute) and f.attr in LOG_METHODS:
+        return True
+    return dotted(f) in ("warnings.warn", "traceback.print_exc")
+
+
+class ExcSwallowRule(Rule):
+    id = "EXC-SWALLOW"
+    summary = ("broad except that neither raises, logs, nor uses the "
+               "exception — failures vanish into hangs")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            t = node.type
+            broad = t is None or (isinstance(t, ast.Name) and t.id in _BROAD)
+            if not broad:
+                continue
+            has_raise = has_log = uses_exc = False
+            for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+                if isinstance(sub, ast.Raise):
+                    has_raise = True
+                elif isinstance(sub, ast.Call) and _is_loggingish(sub):
+                    has_log = True
+                elif node.name and isinstance(sub, ast.Name) \
+                        and sub.id == node.name \
+                        and isinstance(sub.ctx, ast.Load):
+                    uses_exc = True
+            if has_raise or has_log or uses_exc:
+                continue
+            what = "bare except" if t is None else f"except {t.id}"
+            out.append(ctx.finding(
+                self.id, node,
+                f"{what} swallows the failure (no raise/log/use of the "
+                "exception): narrow the type, log it, or suppress with a "
+                "justification"))
+        return out

@@ -1,0 +1,292 @@
+"""JAX-boundary rules: what the tracer silently does to Python code.
+
+JIT-CLOSURE         array-valued global/self-attr read inside a traced fn
+JIT-SIDE-EFFECT     print/logging/wall-clock inside a traced fn
+JIT-IN-LOOP         jax.jit(...) constructed (or .astype re-lowered) per
+                    loop iteration
+DONATE-MISS         train-step-shaped jit without donate_argnums
+HOST-SYNC-IN-HOT-LOOP  device→host sync inside a decode/step loop
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.engine import FileContext, Finding, Rule
+from tools.graftlint.rules._shared import (
+    LOG_METHODS,
+    bound_names,
+    collect_jitted_cached,
+    dotted,
+    is_jit_construction,
+)
+
+_ARRAY_FACTORY = re.compile(
+    r"^(jnp|np|numpy|jax\.numpy)\."
+    r"(array|asarray|zeros|ones|full|arange|linspace|eye|empty|"
+    r"zeros_like|ones_like|full_like)$"
+)
+
+
+def _is_array_factory(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    return bool(d and _ARRAY_FACTORY.match(d))
+
+
+class JitClosureRule(Rule):
+    id = "JIT-CLOSURE"
+    summary = ("jitted function closes over an array-valued global or "
+               "self-attribute — it is baked in as a constant at trace "
+               "time (silent staleness) and any rebind re-lowers")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        array_globals: set[str] = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and _is_array_factory(stmt.value):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        array_globals.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                    and _is_array_factory(stmt.value) \
+                    and isinstance(stmt.target, ast.Name):
+                array_globals.add(stmt.target.id)
+
+        # self.X = jnp.array(...) per class → attr names that hold arrays.
+        class_attrs: dict[str, set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs: set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _is_array_factory(sub.value):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            attrs.add(t.attr)
+            if attrs:
+                class_attrs[node.name] = attrs
+
+        for jf in collect_jitted_cached(ctx):
+            bound = bound_names(jf.node)
+            body = jf.node.body if isinstance(jf.node.body, list) \
+                else [jf.node.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Name) \
+                            and isinstance(node.ctx, ast.Load) \
+                            and node.id in array_globals \
+                            and node.id not in bound:
+                        out.append(ctx.finding(
+                            self.id, node,
+                            f"`{jf.name}` is traced but reads module-level "
+                            f"array `{node.id}` from its closure: the value "
+                            "is constant-folded at trace time — pass it as "
+                            "an argument"))
+                    elif isinstance(node, ast.Attribute) \
+                            and isinstance(node.ctx, ast.Load) \
+                            and isinstance(node.value, ast.Name) \
+                            and node.value.id == "self" \
+                            and jf.owner_class is not None \
+                            and node.attr in class_attrs.get(
+                                jf.owner_class.name, ()):
+                        out.append(ctx.finding(
+                            self.id, node,
+                            f"`{jf.name}` is traced but reads array attr "
+                            f"`self.{node.attr}`: bound-method jit captures "
+                            "self — the array constant-folds; pass it as an "
+                            "argument"))
+        return out
+
+
+_LOGGER_NAMES = {"logger", "logging", "log", "LOG", "LOGGER"}
+_WALLCLOCK = {"time.time", "time.perf_counter", "time.monotonic",
+              "time.time_ns", "time.perf_counter_ns"}
+
+
+class JitSideEffectRule(Rule):
+    id = "JIT-SIDE-EFFECT"
+    summary = ("side effect inside a traced function runs once at trace "
+               "time, then never again (use jax.debug.print / host_callback)")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for jf in collect_jitted_cached(ctx):
+            body = jf.node.body if isinstance(jf.node.body, list) \
+                else [jf.node.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id == "print":
+                        out.append(ctx.finding(
+                            self.id, node,
+                            f"print() inside traced `{jf.name}` fires at "
+                            "trace time only — use jax.debug.print"))
+                    elif isinstance(f, ast.Attribute) \
+                            and f.attr in LOG_METHODS \
+                            and isinstance(f.value, ast.Name) \
+                            and f.value.id in _LOGGER_NAMES:
+                        out.append(ctx.finding(
+                            self.id, node,
+                            f"logging call inside traced `{jf.name}` fires "
+                            "at trace time only"))
+                    elif dotted(f) in _WALLCLOCK:
+                        out.append(ctx.finding(
+                            self.id, node,
+                            f"wall-clock read inside traced `{jf.name}` is "
+                            "frozen at trace time — time outside the jit "
+                            "boundary"))
+        return out
+
+
+class JitInLoopRule(Rule):
+    id = "JIT-IN-LOOP"
+    summary = ("jax.jit(...) constructed inside a loop body re-lowers "
+               "every iteration (each call makes a fresh cache)")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        jitted_nodes = {id(jf.node) for jf in collect_jitted_cached(ctx)}
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.fn_stack: list[ast.AST] = []
+                self.loop_depth = 0
+                self.in_jitted = 0
+
+            def _fn(self, node):
+                self.fn_stack.append(node)
+                jitted = id(node) in jitted_nodes
+                self.in_jitted += jitted
+                saved, self.loop_depth = self.loop_depth, 0
+                self.generic_visit(node)
+                self.loop_depth = saved
+                self.in_jitted -= jitted
+                self.fn_stack.pop()
+
+            visit_FunctionDef = _fn
+            visit_AsyncFunctionDef = _fn
+            visit_Lambda = _fn
+
+            def _loop(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            visit_For = _loop
+            visit_While = _loop
+            visit_AsyncFor = _loop
+
+            def visit_Call(self, node):
+                in_fn_loop = self.loop_depth > 0 and self.fn_stack
+                if in_fn_loop:
+                    if is_jit_construction(node):
+                        out.append(ctx.finding(
+                            JitInLoopRule.id, node,
+                            "jit wrapper constructed inside a loop body: "
+                            "every iteration builds a fresh compilation "
+                            "cache — hoist the jit out of the loop"))
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "astype" \
+                            and self.in_jitted > 0:
+                        out.append(ctx.finding(
+                            JitInLoopRule.id, node,
+                            ".astype inside a Python loop in a traced "
+                            "function inserts a convert per unrolled "
+                            "iteration — cast once before the loop "
+                            "(see the per-layer re-lower fixed in the "
+                            "paged-attention PR)"))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return out
+
+
+_STEP_NAME = re.compile(r"(train|update|step)", re.I)
+
+
+class DonateMissRule(Rule):
+    id = "DONATE-MISS"
+    summary = ("train/update-step-shaped jit without donate_argnums: the "
+               "old params/opt-state buffers stay live across the step — "
+               "2x peak HBM for the largest arrays in the program")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        for jf in collect_jitted_cached(ctx):
+            if jf.donate or not _STEP_NAME.search(jf.name):
+                continue
+            out.append(ctx.finding(
+                self.id, jf.site,
+                f"jit of `{jf.name}` has no donate_argnums/donate_argnames "
+                "— donate the carried state (params/opt_state/cache) so "
+                "XLA can reuse its buffers in-place"))
+        return out
+
+
+_HOT_NAME = re.compile(r"(decode|generate|sample|scan|step|_loop)", re.I)
+_HOST_SYNC_DOTTED = {"np.asarray", "numpy.asarray", "np.array",
+                     "numpy.array", "jax.device_get"}
+
+
+class HostSyncInHotLoopRule(Rule):
+    id = "HOST-SYNC-IN-HOT-LOOP"
+    summary = ("device→host sync inside a decode/step loop serializes the "
+               "loop on transfer latency and kills async dispatch")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.hot_fn: list[str] = []
+                self.loop_depth = 0
+
+            def _fn(self, node):
+                hot = bool(_HOT_NAME.search(node.name))
+                if hot:
+                    self.hot_fn.append(node.name)
+                saved, self.loop_depth = self.loop_depth, 0
+                self.generic_visit(node)
+                self.loop_depth = saved
+                if hot:
+                    self.hot_fn.pop()
+
+            visit_FunctionDef = _fn
+            visit_AsyncFunctionDef = _fn
+
+            def _loop(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            visit_For = _loop
+            visit_While = _loop
+
+            def visit_Call(self, node):
+                if self.hot_fn and self.loop_depth > 0:
+                    f = node.func
+                    msg = None
+                    if isinstance(f, ast.Attribute) and f.attr in (
+                            "item", "block_until_ready"):
+                        msg = (f".{f.attr}() inside the `{self.hot_fn[-1]}` "
+                               "loop forces a device sync per iteration")
+                    elif dotted(f) in _HOST_SYNC_DOTTED:
+                        msg = (f"{dotted(f)}(...) inside the "
+                               f"`{self.hot_fn[-1]}` loop copies device→"
+                               "host per iteration — batch the transfer "
+                               "outside the loop or amortize over a "
+                               "multi-step window")
+                    if msg:
+                        out.append(ctx.finding(
+                            HostSyncInHotLoopRule.id, node, msg))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return out

@@ -1,0 +1,128 @@
+"""SER-CAPTURE: a `.remote()` or `put()` whose payload provably contains a
+known-unpicklable object (thread locks, file handles, sockets, event
+loops, live processes) fails at submit time with a bare cloudpickle
+traceback — or worse, at restore time on another node. This rule is the
+static sibling of `ray_tpu.utils.check_serialize.inspect_serializability`
+(which the submit path now runs on failure to localize the culprit); the
+lint catches the cases provable without executing anything.
+
+Tracked: names assigned one of the unpicklable constructors in a visible
+scope, passed either directly as a `.remote()`/`put()` argument or
+captured as a free variable of a local function that is itself submitted.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import FileContext, Finding, Rule
+from tools.graftlint.rules._shared import dotted, free_names
+
+_UNPICKLABLE_CTORS = {
+    "threading.Lock": "thread lock",
+    "threading.RLock": "thread lock",
+    "threading.Condition": "condition variable (wraps a lock)",
+    "threading.Event": "event (wraps a lock)",
+    "threading.Semaphore": "semaphore (wraps a lock)",
+    "open": "file handle",
+    "socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "asyncio.get_event_loop": "event loop",
+    "asyncio.get_running_loop": "event loop",
+    "asyncio.new_event_loop": "event loop",
+    "subprocess.Popen": "live process handle",
+    "sqlite3.connect": "database connection",
+}
+
+
+def _ctor_kind(value: ast.AST) -> str | None:
+    if isinstance(value, ast.Call):
+        d = dotted(value.func)
+        if d in _UNPICKLABLE_CTORS:
+            return _UNPICKLABLE_CTORS[d]
+    return None
+
+
+def _is_submit_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "remote":
+        return True
+    d = dotted(f)
+    return d in ("ray_tpu.put", "ray.put")
+
+
+class SerCaptureRule(Rule):
+    id = "SER-CAPTURE"
+    summary = (".remote()/put() payload contains a known-unpicklable "
+               "object — fails with a bare cloudpickle TypeError at "
+               "submit (run utils.check_serialize.inspect_serializability "
+               "for the full culprit chain)")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        rule_id = self.id
+
+        class V(ast.NodeVisitor):
+            """Lexical scope stack: closure lookup walks outward, so an
+            inner `.remote()` sees outer locks, but sibling functions
+            never see each other's locals."""
+
+            def __init__(self):
+                self.tracked: list[dict[str, str]] = [{}]
+                self.local_defs: list[dict[str, ast.FunctionDef]] = [{}]
+
+            def _lookup(self, stack, name):
+                for frame in reversed(stack):
+                    if name in frame:
+                        return frame[name]
+                return None
+
+            def _fn(self, node):
+                self.local_defs[-1][node.name] = node
+                self.tracked.append({})
+                self.local_defs.append({})
+                self.generic_visit(node)
+                self.tracked.pop()
+                self.local_defs.pop()
+
+            visit_FunctionDef = _fn
+            visit_AsyncFunctionDef = _fn
+
+            def visit_Assign(self, node):
+                kind = _ctor_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.tracked[-1][t.id] = kind
+                self.generic_visit(node)
+
+            def visit_Call(self, node):
+                if _is_submit_call(node):
+                    args = list(node.args) + [k.value for k in node.keywords]
+                    for arg in args:
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        kind = self._lookup(self.tracked, arg.id)
+                        if kind:
+                            out.append(ctx.finding(
+                                rule_id, node,
+                                f"`{arg.id}` ({kind}) cannot be pickled "
+                                "across the task boundary — reconstruct "
+                                "it on the worker instead"))
+                            continue
+                        fdef = self._lookup(self.local_defs, arg.id)
+                        if fdef is not None:
+                            for name in sorted(free_names(fdef)):
+                                k = self._lookup(self.tracked, name)
+                                if k:
+                                    out.append(ctx.finding(
+                                        rule_id, node,
+                                        f"submitted function `{arg.id}` "
+                                        f"closes over `{name}` ({k}) — "
+                                        "the closure cannot be pickled; "
+                                        "pass the resource's "
+                                        "construction, not the resource"))
+                self.generic_visit(node)
+
+        V().visit(ctx.tree)
+        return out
