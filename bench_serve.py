@@ -29,8 +29,19 @@ def main() -> None:
     ap.add_argument("--clients", type=int, default=16)
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--max-tokens-spread", type=int, default=0,
+                    help="± uniform per-request jitter on --max-tokens"
+                         " (deterministic multiset). Constant output"
+                         " lengths keep every admission wave synchronized"
+                         " — the one-shot path's best case and unlike"
+                         " real traffic; jitter staggers completions")
     ap.add_argument("--prompt-len", type=int, default=48)
     ap.add_argument("--n-slots", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=1024,
+                    help="KV capacity per slot; size to the workload —"
+                         " paged-attention reads scale with the live page"
+                         " width, and the chunked-prefill gather path's"
+                         " prefix attention scales with it on CPU")
     ap.add_argument("--decode-block", type=int, default=16,
                     help="fused decode window: tokens per dispatch")
     ap.add_argument("--bf16", action="store_true",
@@ -47,8 +58,20 @@ def main() -> None:
                          " paged attention (ops/paged_attention.py),"
                          " gather = reference timeline reconstitution"
                          " (default: the llm_attn_impl config knob)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked prefill (paged mode): tokens per prefill"
+                         " chunk, co-scheduled against decode; 0 = one-shot"
+                         " whole-prompt admission")
+    ap.add_argument("--prefill-budget", type=int, default=None,
+                    help="max prefill tokens per engine tick while decode"
+                         " is active (default: llm_prefill_token_budget)")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
+    if args.max_tokens_spread < 0:
+        ap.error("--max-tokens-spread must be >= 0")
+    if args.max_tokens_spread >= args.max_tokens:
+        ap.error("--max-tokens-spread must be < --max-tokens"
+                 " (a request must generate at least one token)")
 
     if args.model == "tiny":
         # CI path: force the CPU backend before jax initializes.
@@ -71,10 +94,13 @@ def main() -> None:
             lambda a: a.astype(jnp.bfloat16)
             if a.dtype == jnp.float32 else a,
             gpt.init_params(cfg, jax.random.key(0)))
-    engine = LLMEngine(cfg, params, n_slots=args.n_slots, max_len=1024,
+    engine = LLMEngine(cfg, params, n_slots=args.n_slots,
+                       max_len=args.max_len,
                        decode_block=args.decode_block,
                        kv_mode=args.kv_mode, page_size=args.page_size,
-                       n_pages=args.n_pages, attn_impl=args.attn_impl)
+                       n_pages=args.n_pages, attn_impl=args.attn_impl,
+                       prefill_chunk=args.prefill_chunk,
+                       prefill_token_budget=args.prefill_budget)
     rng = np.random.default_rng(0)
 
     # Warm every admission-group size (8/4/2/1 batched prefill) and every
@@ -90,7 +116,12 @@ def main() -> None:
         if burst <= args.n_slots:
             drive([engine.submit(prompt(), max_tokens=2)
                    for _ in range(burst)])
-    drive([engine.submit(prompt(), max_tokens=args.max_tokens)])
+    # Drive one request to the LONGEST output the measured traffic can
+    # reach: page-table width buckets double as slots grow, and a width
+    # the warmup never visited would compile its decode programs
+    # mid-measurement (seconds of XLA time booked against one window).
+    drive([engine.submit(prompt(),
+                         max_tokens=args.max_tokens + args.max_tokens_spread)])
     # Engine-side counters restart here so the reported device-time split
     # covers ONLY the measured window (warmup compiles would skew it).
     engine.reset_stats()
@@ -99,15 +130,22 @@ def main() -> None:
     results = []
     lock = threading.Lock()
     todo = list(range(args.requests))
+    # Per-request output budgets precomputed so the workload multiset is
+    # deterministic regardless of client-thread scheduling.
+    spread = args.max_tokens_spread
+    budgets = [
+        max(1, args.max_tokens - spread + int(rng.integers(0, 2 * spread + 1)))
+        if spread else args.max_tokens
+        for _ in range(args.requests)]
 
     def client():
         while True:
             with lock:
                 if not todo:
                     return
-                todo.pop()
+                i = todo.pop()
             ids = list(rng.integers(0, cfg.vocab_size, args.prompt_len))
-            req = engine.submit(ids, max_tokens=args.max_tokens)
+            req = engine.submit(ids, max_tokens=budgets[i])
             req.done.wait(600)
             if req.error:
                 continue
@@ -147,11 +185,26 @@ def main() -> None:
             em.get("engine_decode_tok_s", 0.0), 1),
         "engine_prefill_tok_per_s": round(
             em.get("engine_prefill_tok_s", 0.0), 1),
+        # Engine-side TTFT percentiles (submit → first token measured in
+        # the engine thread, no client/router path) — the number chunked
+        # prefill moves.
+        "engine_ttft_ms_p50": em.get("ttft_ms_p50", 0.0),
+        "engine_ttft_ms_p95": em.get("ttft_ms_p95", 0.0),
         # Engine-side per-token step-time percentiles (window wall time /
         # window size, measured inside the engine loop) — the roofline-
         # facing number the paged-attention kernel moves.
         "decode_step_ms_p50": em.get("decode_step_ms_p50", 0.0),
         "decode_step_ms_p95": em.get("decode_step_ms_p95", 0.0),
+        # Prefill interference: per-token decode latency window-END to
+        # window-END across ticks that also ran prefill (admission stall
+        # included) — the decode-stall bound the prefill token budget
+        # enforces; the one-shot vs chunked ablation reads off here.
+        "decode_step_burst_ms_p50": em.get("decode_step_burst_ms_p50", 0.0),
+        "decode_step_burst_ms_p95": em.get("decode_step_burst_ms_p95", 0.0),
+        "prefill_chunk": args.prefill_chunk,
+        "prefill_budget": (args.prefill_budget if args.prefill_budget
+                           is not None else engine.prefill_budget),
+        "prefill_chunks_dispatched": em.get("prefill_chunks", 0),
         "slot_occupancy": round(em.get("slot_occupancy", 0.0), 4),
         "decode_time_s": round(em.get("decode_time_s", 0.0), 2),
         "prefill_time_s": round(em.get("prefill_time_s", 0.0), 2),

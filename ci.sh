@@ -42,6 +42,7 @@ case "$TIER" in
       tests/test_tracing.py           # distributed tracing across hops
       tests/test_llm_serve.py         # LLM engine: paged KV, batching
       tests/test_paged_attention.py   # Pallas ragged paged-attn kernel
+      tests/test_chunked_prefill.py   # chunked prefill + token budget
       tests/test_tune.py              # Tune: schedulers/searchers
       tests/test_workflow.py          # Workflows: DAG + resume
       tests/test_ops_layer.py         # model ops numerics
@@ -60,7 +61,7 @@ esac
 # the kernel tests silently (the module asserts the interpret-mode
 # fallback instead of importorskip'ing).
 for guarded in tests/test_tracing.py tests/test_paged_attention.py \
-               tests/test_graftlint.py; do
+               tests/test_chunked_prefill.py tests/test_graftlint.py; do
   collected=$(python -m pytest "${guarded}" --collect-only -q \
     -p no:cacheprovider 2>/dev/null | grep -c "^${guarded}" || true)
   if [ "${collected}" -eq 0 ]; then

@@ -253,6 +253,20 @@ class Config:
     # timeline in HBM — the throughput path on real chips; runs under
     # interpret=True off-TPU). Env: RAY_TPU_LLM_ATTN_IMPL=kernel.
     llm_attn_impl: str = "gather"
+    # Chunked prefill (paged mode only): prompts enter their slot's page
+    # table in fixed-size chunks co-scheduled against decode instead of
+    # one whole-prompt prefill per admission. 0 = one-shot bucketed
+    # admission (legacy). >0 = chunk size in tokens; every chunk of every
+    # prompt length lowers the SAME two programs (interior + final), so
+    # the prefill compile grid collapses from buckets × admission-ladder
+    # to 2. Env: RAY_TPU_LLM_PREFILL_CHUNK=64.
+    llm_prefill_chunk: int = 0
+    # Max prefill tokens one engine tick may run while decode is active
+    # (the decode-stall bound: a tick's prefill work never exceeds this).
+    # 0 = pure-decode ticks (prefill only advances while nothing is
+    # decoding); otherwise must be >= llm_prefill_chunk. Ignored unless
+    # llm_prefill_chunk > 0.
+    llm_prefill_token_budget: int = 256
 
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"

@@ -108,6 +108,108 @@ def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
     return last, {"k": new_k, "v": new_v}
 
 
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("return_logits", "attn_impl"),
+                   donate_argnums=(3,))
+def prefill_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
+                        offsets, n_valid, *, return_logits: bool = True,
+                        attn_impl: str = "gather"):
+    """Write ONE chunk per slot of up to N prompts' KV pages, each at its
+    own arbitrary token offset (Sarathi/Orca-style chunked prefill, one
+    fused dispatch per scheduler tick).
+
+    The compile-count fix for prefill: N and C are engine constants
+    (n_slots × chunk size), `offsets`/`n_valid` are traced vectors, and
+    `tables` are full-width page tables — so every chunk of every prompt
+    length, at any batch occupancy, lowers the same program. Exactly two
+    distinct prefill compilations total (``return_logits`` False for
+    interior-only batches, True when any row carries a final chunk, which
+    alone pays the LM head), replacing the one-shot path's
+    buckets × admission-ladder grid.
+
+    tokens: [N, C] (row = slot; tail chunks padded); tables: [N,
+    max_pages] page ids (pages covering positions
+    ``offsets[i] .. offsets[i]+n_valid[i]-1`` must be allocated);
+    offsets: [N] — absolute position of tokens[i, 0]; n_valid: [N] —
+    valid tokens in row i's chunk (0 = inert row: all writes land on the
+    null page and its logits row is garbage the engine ignores).
+
+    Queries attend causally over everything their slot has written so
+    far: each layer scatters the batch's K/V into its pages FIRST (pad /
+    inert rows land on the null page), then reads back through the page
+    tables — ``gather`` reconstitutes the contiguous timelines
+    (exact-semantics default), ``kernel`` runs the ragged prefill Pallas
+    kernel (ops/paged_attention.py) against the pool in place. Distinct
+    live slots never share a page, so rows are independent.
+
+    → (last-valid-token logits [N, V] fp32 if return_logits else None,
+    updated pool).
+    """
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"attn_impl must be gather|kernel, got {attn_impl!r}")
+    N, C = tokens.shape
+    ps = pool["k"].shape[2]
+    x = params["wte"].astype(cfg.dtype)[tokens]            # [N, C, D]
+    rel = jnp.arange(C)
+    pos = offsets[:, None] + rel[None, :]                  # [N, C]
+    stacked = {k: params[k].astype(cfg.dtype) for k in _BLOCK_KEYS}
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    # Write targets: pad/inert positions (rel >= n_valid) scatter to the
+    # null page — harmless, read-masked. The page index is clamped
+    # because a padded tail's absolute position can run past the table on
+    # a near-max-len prompt.
+    page_idx = jnp.minimum(pos // ps, tables.shape[1] - 1)
+    row_pages = jnp.take_along_axis(tables, page_idx, axis=1)   # [N, C]
+    write_pages = jnp.where(rel[None, :] < n_valid[:, None],
+                            row_pages, 0).reshape(-1)           # [N*C]
+    write_offs = (pos % ps).reshape(-1)                         # [N*C]
+    kv_lens = offsets + n_valid                                 # [N]
+
+    def body(x, inputs):
+        layer, k_pool_l, v_pool_l = inputs
+        h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+        q, k, v = _qkv(h, layer, cfg)
+        q = _rotary_pos(q, cfg.rotary_dim, pos)
+        k = _rotary_pos(k, cfg.rotary_dim, pos)
+        # Write before attending (same order as the decode path): each
+        # row then reads its own chunk's K/V back through its table, so
+        # intra-chunk causality is just the tpos <= qpos mask.
+        k_pool_l = k_pool_l.at[write_pages, write_offs].set(
+            k.reshape(N * C, cfg.n_heads, cfg.head_dim).astype(cfg.dtype))
+        v_pool_l = v_pool_l.at[write_pages, write_offs].set(
+            v.reshape(N * C, cfg.n_heads, cfg.head_dim).astype(cfg.dtype))
+        if attn_impl == "kernel":
+            from ray_tpu.ops.paged_attention import paged_prefill_attention
+
+            attn = paged_prefill_attention(
+                q, k_pool_l, v_pool_l, tables, offsets, kv_lens,
+                sm_scale=scale)
+        else:
+            from ray_tpu.ops.paged_attention import (
+                reference_paged_prefill_attention)
+
+            attn = reference_paged_prefill_attention(
+                q, k_pool_l, v_pool_l, tables, offsets, kv_lens,
+                sm_scale=scale)
+        x = x + jnp.einsum("bchk,hkd->bcd", attn,
+                           layer["wo"].astype(cfg.dtype))
+        x = _mlp(x, layer, cfg)
+        return x, (k_pool_l, v_pool_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (stacked, pool["k"], pool["v"]))
+    pool = {"k": new_k, "v": new_v}
+    if not return_logits:
+        return None, pool
+    logits = _head(params, cfg, x)                         # [N, C, V]
+    last = jnp.take_along_axis(
+        logits,
+        jnp.maximum(n_valid - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]                                      # [N, V]
+    return last, pool
+
+
 def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
                        tables, attn_impl: str = "gather"):
     """All B slots advance one token against the page pool.
@@ -214,6 +316,6 @@ def decode_multi_paged(cfg: GPTConfig, params, tokens, pool, positions,
 
 
 __all__ = [
-    "init_paged_kv", "prefill_batch_paged", "decode_step_paged",
-    "decode_multi_paged",
+    "init_paged_kv", "prefill_batch_paged", "prefill_chunk_paged",
+    "decode_step_paged", "decode_multi_paged",
 ]

@@ -173,6 +173,142 @@ def paged_attention(
     )(tables, lengths, q, k_pool, v_pool)
 
 
+def _prefill_kernel(
+    tables_ref, offsets_ref, lengths_ref,   # scalar-prefetch (SMEM)
+    q_ref, k_ref, v_ref,                    # VMEM blocks
+    o_ref,
+    m_ref, l_ref, acc_ref,                  # VMEM scratch
+    *, sm_scale, page_size, n_pg,
+):
+    """Ragged chunked-prefill attention: one query BLOCK (a prompt chunk at
+    an arbitrary token offset) against the slot's page pool. The decode
+    kernel's twin with a C-sized query dimension: same scalar-prefetch page
+    table (the page id IS the DMA block index), same online-softmax (m, l,
+    acc) VMEM state across the kv-page grid axis — plus the causal mask
+    INSIDE the chunk (tpos <= query's absolute position), which is what
+    lets the chunk's own K/V be written to the pool before the kernel runs
+    and then read back like any earlier page."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kv_len = lengths_ref[b]
+    q_off = offsets_ref[b]
+
+    def _compute():
+        q = q_ref[0]                         # [C, H, K]
+        k = k_ref[0]                         # [ps, H, K]
+        v = v_ref[0]
+        s = jnp.einsum("chk,thk->cht", q, k,
+                       preferred_element_type=jnp.float32) * sm_scale
+        # Causal within the whole sequence: query row c sits at absolute
+        # position q_off + c and may attend tpos <= that. The kv_len bound
+        # additionally masks pad rows (c >= this chunk's valid tokens,
+        # whose absolute position runs past kv_len) to the valid prefix so
+        # their softmax stays finite; their output is discarded host-side.
+        tpos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        s = jnp.where((tpos <= qpos) & (tpos < kv_len), s, NEG_INF)
+
+        m_prev = m_ref[...]                  # [C, H, LANES] (uniform lanes)
+        row_max = jnp.max(s, axis=2, keepdims=True)          # [C, H, 1]
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp(s - m_new[:, :, :1])     # [C, H, ps] fp32
+        corr = jnp.exp(m_prev[:, :, :1] - m_new[:, :, :1])
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=2, keepdims=True)
+        pv = jnp.einsum("cht,thk->chk", p.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = m_new
+
+    # Pages entirely past the chunk's last valid position do no compute
+    # (null-table tail included; its repeated block-0 index map also
+    # elides the DMA after the first fetch).
+    pl.when(j * page_size < kv_len)(_compute)
+
+    @pl.when(j == n_pg - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_prefill_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    tables: jax.Array,
+    offsets: jax.Array,
+    lengths: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Chunked-prefill attention straight against the KV page pool.
+
+    Args:
+      q: [B, C, H, K] — each slot's chunk of C queries (post-rotary),
+        starting at absolute position ``offsets[b]``.
+      k_pool, v_pool: [P, page_size, H, K] — ONE layer's page pool (row 0
+        is the reserved null page). The chunk's own K/V must already be
+        written to its pages (models/paged_kv.py writes before attending,
+        exactly like the decode path).
+      tables: [B, n_pg] int32 page ids per slot (unallocated tail = 0).
+      offsets: [B] int32 absolute position of q[:, 0].
+      lengths: [B] int32 valid kv positions per slot (= offset + valid
+        chunk tokens).
+    Returns [B, C, H, K] in q.dtype; rows past a slot's valid chunk tokens
+    are defined but meaningless (the engine discards them)."""
+    B, C, H, K = q.shape
+    P, ps, Hp, Kp = k_pool.shape
+    if (Hp, Kp) != (H, K) or v_pool.shape != k_pool.shape:
+        raise ValueError(
+            f"pool/query shape mismatch: q {q.shape}, k_pool {k_pool.shape},"
+            f" v_pool {v_pool.shape}")
+    n_pg = tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(K)
+    if interpret is None:
+        interpret = _interpret_default()
+    tables = tables.astype(jnp.int32)
+    offsets = offsets.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    kernel = functools.partial(
+        _prefill_kernel, sm_scale=sm_scale, page_size=ps, n_pg=n_pg)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, n_pg),
+        in_specs=[
+            pl.BlockSpec((1, C, H, K),
+                         lambda b, j, tbl, offs, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, ps, H, K),
+                         lambda b, j, tbl, offs, lens: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps, H, K),
+                         lambda b, j, tbl, offs, lens: (tbl[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, C, H, K), lambda b, j, tbl, offs, lens: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C, H, _LANES), jnp.float32),
+            pltpu.VMEM((C, H, _LANES), jnp.float32),
+            pltpu.VMEM((C, H, K), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, C, H, K), q.dtype),
+        interpret=interpret,
+    )(tables, offsets, lengths, q, k_pool, v_pool)
+
+
 def reference_paged_attention(q, k_pool, v_pool, tables, lengths, *,
                               sm_scale=None):
     """Gather-semantics oracle: reconstitute each slot's contiguous
@@ -193,4 +329,35 @@ def reference_paged_attention(q, k_pool, v_pool, tables, lengths, *,
     return jnp.einsum("bht,bthk->bhk", probs, v_view)
 
 
-__all__ = ["paged_attention", "reference_paged_attention"]
+def reference_paged_prefill_attention(q, k_pool, v_pool, tables, offsets,
+                                      lengths, *, sm_scale=None):
+    """Gather-semantics oracle for chunked prefill: reconstitute each
+    slot's contiguous timeline from the pool and run plain-XLA causal
+    attention for a C-query chunk at absolute offset — byte-for-byte the
+    math of models/paged_kv.py's chunked-prefill gather path (the
+    exact-semantics default off-TPU; also the kernel's test oracle).
+
+    q: [B, C, H, K]; offsets/lengths: [B] (lengths = offset + valid chunk
+    tokens). → [B, C, H, K] in q.dtype."""
+    B, C, H, K = q.shape
+    ps = k_pool.shape[1]
+    T = tables.shape[1] * ps
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(K)
+    k_view = k_pool[tables].reshape(B, T, H, K)
+    v_view = v_pool[tables].reshape(B, T, H, K)
+    s = jnp.einsum("bchk,bthk->bhct", q, k_view,
+                   preferred_element_type=jnp.float32) * sm_scale
+    tpos = jnp.arange(T)                                    # [T]
+    qpos = offsets[:, None] + jnp.arange(C)[None, :]        # [B, C]
+    mask = ((tpos[None, None, :] <= qpos[:, :, None])
+            & (tpos[None, None, :] < lengths[:, None, None]))  # [B, C, T]
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhct,bthk->bchk", probs, v_view)
+
+
+__all__ = [
+    "paged_attention", "paged_prefill_attention",
+    "reference_paged_attention", "reference_paged_prefill_attention",
+]

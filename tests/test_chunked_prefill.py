@@ -1,0 +1,355 @@
+"""Chunked prefill + stall-free token-budget scheduler (serve engine).
+
+Exactness first: the chunked-prefill engine must emit token streams
+byte-identical to the one-shot paged engine (itself exact-match with the
+dense engine) for every chunk size, ragged prompt lengths, both attention
+implementations, and under preempt-by-recompute pool pressure. Then the
+scheduler contracts: the per-tick prefill token budget is a hard cap
+(budget 0 = pure decode ticks), the chunked path lowers at most TWO
+distinct prefill programs (vs the one-shot buckets × admission-ladder
+grid), and a page-blocked queue head no longer head-of-line-blocks
+admission. The prefill kernel runs under interpret=True off-TPU, like the
+decode kernel (tests/test_paged_attention.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import gpt
+from ray_tpu.serve.llm import LLMEngine
+
+CFG = gpt.GPTConfig.tiny(attn_impl="xla", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt.init_params(CFG, jax.random.key(42))
+
+
+def _drive(eng, reqs, max_steps=800):
+    for _ in range(max_steps):
+        if all(r.done.is_set() for r in reqs):
+            break
+        eng.step()
+    assert all(r.done.is_set() for r in reqs)
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    return [r.out_ids for r in reqs]
+
+
+def _run(params, prompts, *, max_tokens=6, n_slots=4, max_len=128,
+         buckets=(64,), **kw):
+    eng = LLMEngine(CFG, params, n_slots=n_slots, max_len=max_len,
+                    prefill_buckets=buckets, **kw)
+    out = _drive(eng, [eng.submit(p, max_tokens=max_tokens)
+                       for p in prompts])
+    return out, eng
+
+
+def _ragged_prompts(rng, lengths):
+    return [list(map(int, rng.integers(1, CFG.vocab_size, n)))
+            for n in lengths]
+
+
+class TestExactness:
+    """Chunked == one-shot == dense, token-for-token."""
+
+    @pytest.mark.parametrize("chunk", [32, 64, 128])
+    def test_matches_oneshot_across_chunk_sizes(self, params, chunk):
+        prompts = _ragged_prompts(
+            np.random.default_rng(0), (3, 17, 33, 50, 7, 40))
+        dense, _ = _run(params, prompts, kv_mode="dense")
+        oneshot, _ = _run(params, prompts, kv_mode="paged", page_size=16)
+        assert oneshot == dense
+        chunked, eng = _run(params, prompts, kv_mode="paged", page_size=16,
+                            prefill_chunk=chunk,
+                            prefill_token_budget=chunk)
+        assert chunked == oneshot
+        m = eng.metrics()
+        assert m["kv_pages_free"] == m["kv_pages_total"]
+        assert m["prefill_chunks"] > 0
+
+    def test_kernel_impl_matches(self, params):
+        """The ragged prefill Pallas kernel (interpret mode off-TPU)
+        produces the same greedy streams as the gather default."""
+        prompts = _ragged_prompts(np.random.default_rng(1), (5, 23, 41))
+        gather, _ = _run(params, prompts, kv_mode="paged", page_size=16,
+                         prefill_chunk=16, prefill_token_budget=32)
+        kernel, eng = _run(params, prompts, kv_mode="paged", page_size=16,
+                           prefill_chunk=16, prefill_token_budget=32,
+                           attn_impl="kernel")
+        assert kernel == gather
+        assert eng.metrics()["llm_attn_impl"] == "kernel"
+
+    def test_exact_under_preemption(self, params):
+        """Pool sized so concurrent slots MUST run dry mid-generation:
+        chunked admission + preempt-by-recompute still reproduce the
+        dense engine's streams exactly."""
+        prompts = [[5, 9, 2], [17, 3], [2, 4, 6], [8, 1, 0]]
+        dense, _ = _run(params, prompts, kv_mode="dense", max_tokens=10,
+                        max_len=64, buckets=(16,))
+        chunked, eng = _run(params, prompts, kv_mode="paged", page_size=4,
+                            n_pages=7, max_tokens=10, max_len=64,
+                            buckets=(16,), prefill_chunk=4,
+                            prefill_token_budget=8)
+        assert chunked == dense
+        m = eng.metrics()
+        assert m["preemptions"] > 0
+        assert m["kv_pages_free"] == m["kv_pages_total"]
+
+    def test_decode_never_truncated_by_prefill_contention(self, params):
+        """Chunked over-admission must not starve an in-flight decode:
+        a long prompt admitted mid-generation grows chunk-by-chunk until
+        the pool runs dry, and the decoding slot then needs a page at a
+        boundary. The window fitter reclaims from the mid-prefill slot
+        (recompute) instead of truncating the decode — a state one-shot
+        whole-prompt admission could never create."""
+        rng = np.random.default_rng(11)
+        longp = list(map(int, rng.integers(1, CFG.vocab_size, 24)))
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=64,
+                        prefill_buckets=(32,), kv_mode="paged", page_size=4,
+                        n_pages=7, decode_block=1, prefill_chunk=4,
+                        prefill_token_budget=4)
+        a = eng.submit([5, 9, 2], max_tokens=12)
+        while a.first_token_at is None:
+            eng.step()
+        b = eng.submit(longp, max_tokens=2)
+        _drive(eng, [a, b])
+        assert not a.truncated and len(a.out_ids) == 12
+        assert not b.truncated and len(b.out_ids) == 2
+        assert eng.stats["preemptions"] > 0   # contention actually hit
+        a_ref, _ = _run(params, [[5, 9, 2]], max_tokens=12,
+                        kv_mode="dense", n_slots=2, buckets=(32,))
+        b_ref, _ = _run(params, [longp], max_tokens=2, kv_mode="dense",
+                        n_slots=2, buckets=(32,))
+        assert a.out_ids == a_ref[0] and b.out_ids == b_ref[0]
+        m = eng.metrics()
+        assert m["kv_pages_free"] == m["kv_pages_total"]
+
+    def test_midflight_admission_exact(self, params):
+        """A long prompt prefilling chunk-by-chunk must not perturb a
+        request already decoding (the fused window walks every slot: the
+        mid-prefill slot's table row is masked to the null page)."""
+        rng = np.random.default_rng(3)
+        longp = _ragged_prompts(rng, (40,))[0]
+        a_ref, _ = _run(params, [[5, 9, 2]], max_tokens=20,
+                        kv_mode="dense", n_slots=2)
+        b_ref, _ = _run(params, [longp], max_tokens=8, kv_mode="dense",
+                        n_slots=2)
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=128,
+                        prefill_buckets=(64,), kv_mode="paged", page_size=8,
+                        prefill_chunk=8, prefill_token_budget=8,
+                        decode_block=4)
+        ra = eng.submit([5, 9, 2], max_tokens=20)
+        for _ in range(3):
+            eng.step()
+        assert ra.first_token_at is not None  # A is decoding
+        rb = eng.submit(longp, max_tokens=8)  # 5 chunks, interleaved
+        _drive(eng, [ra, rb])
+        assert ra.out_ids == a_ref[0]
+        assert rb.out_ids == b_ref[0]
+
+    def test_beyond_bucket_cap(self, params):
+        """Chunked mode is not bucket-bound: a prompt larger than every
+        prefill bucket (one-shot rejects it) is admissible up to the
+        cache cap."""
+        rng = np.random.default_rng(4)
+        prompt = _ragged_prompts(rng, (100,))[0]
+        oneshot = LLMEngine(CFG, params, n_slots=2, max_len=256,
+                            prefill_buckets=(64,), kv_mode="paged",
+                            page_size=16)
+        with pytest.raises(ValueError, match="too long"):
+            oneshot.submit(prompt, max_tokens=4)
+        dense_big, _ = _run(params, [prompt], max_tokens=4,
+                            kv_mode="dense", max_len=256, buckets=(128,))
+        chunked, _ = _run(params, [prompt], max_tokens=4, kv_mode="paged",
+                          page_size=16, max_len=256, buckets=(64,),
+                          prefill_chunk=32, prefill_token_budget=64)
+        assert chunked == dense_big
+
+
+class TestCompileCount:
+    def test_chunked_path_lowers_at_most_two_programs(self, params):
+        """The whole point of the fixed chunk shape: ragged prompt
+        lengths, multi-chunk and single-chunk prompts, partial tails —
+        ONE interior program + ONE final program, not buckets × ladder."""
+        from ray_tpu.models.paged_kv import prefill_chunk_paged
+
+        prefill_chunk_paged.clear_cache()
+        prompts = _ragged_prompts(
+            np.random.default_rng(5), (3, 16, 17, 33, 50, 64, 7))
+        chunked, _ = _run(params, prompts, kv_mode="paged", page_size=16,
+                          prefill_chunk=16, prefill_token_budget=32)
+        assert prefill_chunk_paged._cache_size() <= 2
+
+    def test_oneshot_stream_unaffected_by_cache_clear(self, params):
+        """Sanity companion: clearing the chunk cache above must not
+        disturb one-shot engines (separate jitted programs)."""
+        prompts = [[5, 9, 2], [17, 3]]
+        a, _ = _run(params, prompts, kv_mode="paged", page_size=16)
+        b, _ = _run(params, prompts, kv_mode="dense")
+        assert a == b
+
+
+class TestScheduler:
+    def test_budget_zero_is_pure_decode_tick(self, params):
+        """With decode in flight and budget 0, a tick runs ZERO prefill
+        tokens; the queued prompt only advances once decode drains."""
+        rng = np.random.default_rng(6)
+        longp = _ragged_prompts(rng, (40,))[0]
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=128,
+                        prefill_buckets=(64,), kv_mode="paged", page_size=8,
+                        prefill_chunk=8, prefill_token_budget=0,
+                        decode_block=1)
+        ra = eng.submit([5, 9, 2], max_tokens=30)
+        while ra.first_token_at is None:
+            eng.step()
+        base = eng.stats["prefill_tokens"]
+        rb = eng.submit(longp, max_tokens=4)
+        while not ra.done.is_set():
+            pt = eng.stats["prefill_tokens"]
+            eng.step()
+            if not ra.done.is_set():
+                assert eng.stats["prefill_tokens"] == pt, (
+                    "budget-0 tick ran prefill while decode was active")
+        assert eng.stats["prefill_tokens"] == base
+        _drive(eng, [rb])  # idle ticks still make progress at budget 0
+        assert len(rb.out_ids) == 4
+
+    def test_budget_is_a_hard_cap(self, params):
+        """Oversubscribed queue (many multi-chunk prompts + active
+        decode): no tick ever exceeds the token budget."""
+        rng = np.random.default_rng(7)
+        budget, chunk = 16, 8
+        eng = LLMEngine(CFG, params, n_slots=6, max_len=128,
+                        prefill_buckets=(64,), kv_mode="paged", page_size=8,
+                        prefill_chunk=chunk, prefill_token_budget=budget,
+                        decode_block=2)
+        reqs = [eng.submit(p, max_tokens=6)
+                for p in _ragged_prompts(rng, (40, 33, 25, 40, 17, 40))]
+        # First request(s) reach decode, then every later tick must cap.
+        while not any(r.first_token_at is not None for r in reqs):
+            eng.step()
+        while not all(r.done.is_set() for r in reqs):
+            pt = eng.stats["prefill_tokens"]
+            decoding = any(
+                eng.slot_req[s] is not None and s not in eng._chunk_pos
+                for s in range(eng.n_slots))
+            eng.step()
+            spent = eng.stats["prefill_tokens"] - pt
+            if decoding:
+                assert spent <= budget, (
+                    f"tick ran {spent} prefill tokens past budget {budget}")
+        assert all(r.error is None for r in reqs)
+
+    def test_bad_configs_rejected(self, params):
+        with pytest.raises(ValueError, match="paged"):
+            LLMEngine(CFG, params, n_slots=2, max_len=64,
+                      kv_mode="dense", prefill_chunk=16)
+        with pytest.raises(ValueError, match="prefill_token_budget"):
+            LLMEngine(CFG, params, n_slots=2, max_len=64, kv_mode="paged",
+                      prefill_chunk=16, prefill_token_budget=8)
+        # Negative budget would silently behave like 0 (pure-decode ticks)
+        # — must be rejected, not accepted as "unlimited".
+        with pytest.raises(ValueError, match="prefill_token_budget"):
+            LLMEngine(CFG, params, n_slots=2, max_len=64, kv_mode="paged",
+                      prefill_chunk=16, prefill_token_budget=-1)
+        # A chunk wider than the widest admissible prompt (max_len - 1)
+        # would only ever pad — rejected like the other bad knobs.
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            LLMEngine(CFG, params, n_slots=2, max_len=64, kv_mode="paged",
+                      prefill_chunk=128, prefill_token_budget=128)
+        # Empty prompt: chunked mode would never build a chunk row and
+        # wedge the slot forever; rejected up front in both modes.
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=64,
+                        kv_mode="paged", page_size=16,
+                        prefill_chunk=16, prefill_token_budget=16)
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.submit([], max_tokens=4)
+
+
+class TestAdmissionLookahead:
+    def test_blocked_head_does_not_block_small_requests(self, params):
+        """A queue head whose pages don't fit no longer stalls admission:
+        a small request behind it is admitted (bounded lookahead), the
+        head keeps its queue position and completes once pages free."""
+        rng = np.random.default_rng(8)
+        # Pool of 6 pages (ps=4). R1 occupies a slot and decodes slowly.
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=64,
+                        prefill_buckets=(32,), kv_mode="paged", page_size=4,
+                        n_pages=6, decode_block=1)
+        r1 = eng.submit([5, 9, 2], max_tokens=24)
+        while r1.first_token_at is None:
+            eng.step()
+        # big needs 6 pages — blocked while R1 holds any.
+        big = eng.submit(list(map(int, rng.integers(1, CFG.vocab_size, 20))),
+                         max_tokens=4)
+        small = eng.submit([7, 7], max_tokens=4)  # 1 page: fits now
+        for _ in range(200):
+            eng.step()
+            if small.done.is_set():
+                break
+        assert small.done.is_set(), "small request was HOL-blocked"
+        assert not big.done.is_set() or big.first_token_at is not None
+        _drive(eng, [r1, big])  # no starvation: the head still completes
+        assert big.error is None and len(big.out_ids) == 4
+
+    def test_lookahead_also_in_chunked_mode(self, params):
+        """Same head-of-line fix under chunked admission (head blocked on
+        its FIRST CHUNK of pool headroom)."""
+        rng = np.random.default_rng(9)
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=64,
+                        prefill_buckets=(32,), kv_mode="paged", page_size=4,
+                        n_pages=7, decode_block=1, prefill_chunk=20,
+                        prefill_token_budget=20)
+        r1 = eng.submit([5, 9, 2], max_tokens=24)
+        while r1.first_token_at is None:
+            eng.step()
+        big = eng.submit(list(map(int, rng.integers(1, CFG.vocab_size, 20))),
+                         max_tokens=4)   # first chunk needs 5 pages
+        small = eng.submit([7, 7], max_tokens=4)
+        for _ in range(200):
+            eng.step()
+            if small.done.is_set():
+                break
+        assert small.done.is_set(), "small request was HOL-blocked"
+        _drive(eng, [r1, big])
+        assert big.error is None and len(big.out_ids) == 4
+
+
+class TestObservability:
+    def test_prefill_chunk_histogram_and_ttft_breakdown(self, params):
+        from ray_tpu import profiling
+        from ray_tpu.serve.llm import _PREFILL_CHUNK_HIST
+
+        prompts = _ragged_prompts(np.random.default_rng(10), (33, 17))
+        _, eng = _run(params, prompts, kv_mode="paged", page_size=16,
+                      prefill_chunk=16, prefill_token_budget=32)
+        m = eng.metrics()
+        assert m["prefill_chunk"] == 16
+        assert m["prefill_token_budget"] == 32
+        assert m["ttft_ms_p50"] > 0
+        assert m["ttft_ms_p95"] >= m["ttft_ms_p50"]
+        counts, _sums = _PREFILL_CHUNK_HIST.snapshot_hist()
+        assert counts, "chunk dispatches observed no histogram samples"
+        # Sampled TTFT breakdown spans (first request always emits).
+        names = {e.get("name") for e in profiling.peek_events()}
+        assert {"llm.ttft", "llm.ttft.queue_wait", "llm.ttft.prefill",
+                "llm.ttft.first_token"} <= names
+        ev = next(e for e in profiling.peek_events()
+                  if e.get("name") == "llm.ttft")
+        assert "trace_id" in ev.get("args", {})
+
+    def test_request_chunk_timestamps(self, params):
+        eng = LLMEngine(CFG, params, n_slots=2, max_len=128,
+                        prefill_buckets=(64,), kv_mode="paged",
+                        page_size=16, prefill_chunk=16,
+                        prefill_token_budget=16)
+        req = eng.submit(list(range(1, 34)), max_tokens=3)  # 3 chunks
+        _drive(eng, [req])
+        assert req.first_chunk_at is not None
+        assert req.last_chunk_at is not None
+        assert (req.submitted_at <= req.first_chunk_at
+                <= req.last_chunk_at <= req.first_token_at)
