@@ -125,6 +125,14 @@ def main() -> None:
     # Engine-side counters restart here so the reported device-time split
     # covers ONLY the measured window (warmup compiles would skew it).
     engine.reset_stats()
+    # Compile-watch baseline (flight recorder): the warmup above is
+    # supposed to have visited every program shape the measured traffic
+    # hits, so jax_compiles_delta should be 0 — a non-zero delta in a
+    # committed BENCH JSON is a recompile regression caught from the
+    # artifact alone, not from step-time noise.
+    from ray_tpu import compile_watch
+
+    compiles0 = compile_watch.compiles_total()
     engine.start()
 
     results = []
@@ -210,10 +218,22 @@ def main() -> None:
         "prefill_time_s": round(em.get("prefill_time_s", 0.0), 2),
         "preemptions": em.get("preemptions", 0),
         "decode_block": args.decode_block,
+        # XLA compiles paid inside the measured window (0 after a correct
+        # warmup; see the compile-watch baseline above).
+        "jax_compiles_delta": int(
+            compile_watch.compiles_total() - compiles0),
     }
     if args.kv_mode == "paged":
         row["kv_pages_total"] = em.get("kv_pages_total")
         row["kv_page_size"] = em.get("kv_page_size")
+        # Peak pool occupancy over the measured window (pool low-water
+        # mark): how close the run came to page exhaustion — pressure
+        # regressions show up here before they show up as preemptions.
+        free_min = em.get("kv_pages_free_min")
+        row["kv_pages_free_min"] = free_min
+        if free_min is not None and em.get("kv_pages_total"):
+            row["kv_pool_peak_occupancy"] = round(
+                1.0 - free_min / em["kv_pages_total"], 4)
         # Which attention implementation produced this row — kernel vs
         # gather ablations must be distinguishable from the JSON alone.
         row["llm_attn_impl"] = em.get("llm_attn_impl", engine.attn_impl)

@@ -49,6 +49,7 @@ case "$TIER" in
       tests/test_rllib_eval.py        # RLlib: eval workers + callbacks
       tests/test_sharding_audit.py    # SPMD audit arithmetic
       tests/test_graftlint.py         # static-analysis rules + baseline
+      tests/test_flight_recorder.py   # compile watch / load / SLO
     ) ;;
   *) echo "usage: $0 [fast|full|quick]" >&2; exit 2 ;;
 esac
@@ -61,7 +62,8 @@ esac
 # the kernel tests silently (the module asserts the interpret-mode
 # fallback instead of importorskip'ing).
 for guarded in tests/test_tracing.py tests/test_paged_attention.py \
-               tests/test_chunked_prefill.py tests/test_graftlint.py; do
+               tests/test_chunked_prefill.py tests/test_graftlint.py \
+               tests/test_flight_recorder.py; do
   collected=$(python -m pytest "${guarded}" --collect-only -q \
     -p no:cacheprovider 2>/dev/null | grep -c "^${guarded}" || true)
   if [ "${collected}" -eq 0 ]; then

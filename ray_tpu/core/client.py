@@ -1914,6 +1914,14 @@ class CoreClient:
             except Exception:  # graftlint: disable=EXC-SWALLOW (kill is best-effort by contract)
                 pass
 
+    # -------------------------------------------------- cluster events
+
+    def event_add(self, payload: dict) -> None:
+        """Append one structured cluster event (GCS `event_add`; read back
+        via state.list_cluster_events)."""
+        self._run(self.gcs.call("event_add", payload),
+                  timeout=self.config.rpc_default_timeout_s)
+
     # -------------------------------------------------- kv
 
     def kv_put(self, ns: str, key: bytes, value: bytes,

@@ -268,6 +268,20 @@ class Config:
     # llm_prefill_chunk > 0.
     llm_prefill_token_budget: int = 256
 
+    # --- flight recorder (compile watch + SLO monitor) ---
+    # Recompile-storm alarm (ray_tpu/compile_watch.py): a structured
+    # `recompile.storm` cluster event fires when one traced program label
+    # compiles more than `threshold` times inside the rolling window —
+    # the production alarm for silent per-step recompile churn (the
+    # decode-table-width class of bug).
+    jax_recompile_storm_threshold: int = 10
+    jax_recompile_storm_window_s: float = 120.0
+    # Default SLO objectives (ray_tpu/slo.py): rolling evaluation window
+    # and p95 latency targets for LLM TTFT and ingress request latency.
+    slo_window_s: float = 300.0
+    slo_ttft_p95_s: float = 2.0
+    slo_request_p95_s: float = 5.0
+
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"
     # Machine-persistent root for built pip runtime envs ("" = under the

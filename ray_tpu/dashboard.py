@@ -110,6 +110,24 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.serve.schema import app_statuses
 
                 return self._json(app_statuses())
+            if self.path in ("/api/serve/load", "/api/serve/load/"):
+                # Per-replica engine load (flight recorder): queue depth,
+                # slot/pool fill, TTFT/decode EWMAs from each replica's
+                # last stats probe — the router/autoscaler signal surface.
+                from ray_tpu.serve.api import CONTROLLER_NAME
+
+                try:
+                    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+                except ValueError:
+                    return self._json({"deployments": {}})
+                return self._json({"deployments": ray_tpu.get(
+                    ctrl.get_load.remote(), timeout=30)})
+            if self.path in ("/api/slo", "/api/slo/"):
+                # Rolling-window SLO status over the cluster histograms
+                # (ray_tpu/slo.py): burn rates, quantile estimates, and
+                # violation flags per objective.
+                return self._json(
+                    {"objectives": _slo_monitor().evaluate()})
             if self.path in ("/api/jobs", "/api/jobs/"):
                 return self._json(ray_tpu.get(
                     self.server.jobs.list.remote(), timeout=30))
@@ -178,6 +196,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": "unknown endpoint"}, 404)
         except Exception as e:
             self._json({"error": repr(e)}, 500)
+
+
+# One SLO monitor per dashboard process: /api/slo polls difference
+# consecutive histogram snapshots, so the monitor must persist across
+# requests for the rolling window to exist (first poll = lifetime view).
+_slo_state: dict = {"monitor": None, "lock": threading.Lock()}
+
+
+def _slo_monitor():
+    with _slo_state["lock"]:
+        if _slo_state["monitor"] is None:
+            from ray_tpu.slo import SloMonitor
+
+            _slo_state["monitor"] = SloMonitor()
+        return _slo_state["monitor"]
 
 
 # Minimal single-page UI over the JSON API (the reference ships a React

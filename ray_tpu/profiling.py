@@ -290,6 +290,15 @@ def metrics_snapshot() -> list[dict]:
     return out
 
 
+# Cumulative histogram-merge-conflict tally per metric name (rendered as
+# metrics_merge_conflicts_total; see prometheus_text). Module state, not a
+# registered Counter: it must count RENDERS of conflicting rows in this
+# process without also being flushed to the hub and merged back into the
+# very exposition that increments it.
+_merge_conflicts_total: dict[str, int] = {}
+_merge_conflicts_lock = threading.Lock()
+
+
 def prometheus_text(rows: list[dict]) -> str:
     """Render aggregated metric rows in Prometheus exposition format.
     Counter rows with identical (name, tags) are summed; gauges keep the
@@ -299,6 +308,7 @@ def prometheus_text(rows: list[dict]) -> str:
     scalars: dict[tuple, float] = {}
     hists: dict[tuple, dict] = {}
     meta: dict[str, tuple[str, str]] = {}
+    conflicts: dict[str, int] = {}
     for r in rows:
         name = r["name"]
         tags = tuple(sorted(r.get("tags", {}).items()))
@@ -318,7 +328,10 @@ def prometheus_text(rows: list[dict]) -> str:
             else:
                 # Same metric name flushed with different boundaries (a
                 # definition conflict across processes): the row can't be
-                # merged bucket-wise — say so instead of losing it silently.
+                # merged bucket-wise — warn AND account for it in the
+                # exposition itself (metrics_merge_conflicts_total below),
+                # so the data loss is visible to scrapers, not just logs.
+                conflicts[name] = conflicts.get(name, 0) + 1
                 logger.warning(
                     "histogram %s: boundary mismatch across sources "
                     "(%s vs %s); dropping a conflicting row from exposition",
@@ -328,11 +341,38 @@ def prometheus_text(rows: list[dict]) -> str:
         else:
             scalars[key] = r["value"]
 
+    # Process-cumulative tally of dropped conflicting rows (real counter
+    # semantics: monotone across scrapes and still present after the
+    # conflict clears, so increase(metrics_merge_conflicts_total[5m])
+    # fires while data is being dropped instead of totals silently
+    # shrinking). Kept in a plain module dict — NOT a registered Counter —
+    # so a hub-flushed copy of a past render can't merge with the live
+    # tally and double count.
+    with _merge_conflicts_lock:
+        for name, n in conflicts.items():
+            _merge_conflicts_total[name] = (
+                _merge_conflicts_total.get(name, 0) + n)
+        snapshot_conflicts = dict(_merge_conflicts_total)
+    if snapshot_conflicts:
+        meta["metrics_merge_conflicts_total"] = (
+            "counter", "Histogram rows dropped from exposition due to "
+            "bucket-boundary mismatch across sources")
+        for name, n in snapshot_conflicts.items():
+            key = ("metrics_merge_conflicts_total", (("metric", name),))
+            scalars[key] = scalars.get(key, 0.0) + n
+
     lines: list[str] = []
     emitted: set[str] = set()
 
+    def escape(value) -> str:
+        # Prometheus exposition label-value escaping: backslash, double
+        # quote, and newline in a tag value would otherwise corrupt the
+        # whole scrape page.
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
     def labels(tags, extra=()) -> str:
-        return ",".join(f'{k}="{v}"' for k, v in (*tags, *extra))
+        return ",".join(f'{k}="{escape(v)}"' for k, v in (*tags, *extra))
 
     def emit_meta(name: str) -> None:
         if name in emitted:

@@ -133,6 +133,70 @@ def cmd_status(args) -> None:
     for k in sorted(s["resources_total"]):
         avail = s["resources_available"].get(k, 0)
         print(f"  {k}: {avail:g}/{s['resources_total'][k]:g} available")
+    if getattr(args, "serve", False):
+        print(render_serve_status())
+
+
+def render_serve_status() -> str:
+    """`status --serve` body: per-deployment replica counts with each
+    replica's live engine load (controller get_load) and the SLO table
+    over the cluster histograms. Factored out of cmd_status so tests can
+    assert the rendering against a live controller without re-attaching."""
+    import ray_tpu
+    from ray_tpu import state
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    lines = ["serve:"]
+    try:
+        ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+        load = ray_tpu.get(ctrl.get_load.remote(), timeout=30)
+    except Exception as e:
+        lines.append(f"  (no serve controller: {e})")
+        load = {}
+    for name, info in sorted(load.items()):
+        lines.append(
+            f"  {name} (route {info.get('route_prefix') or '-'}): "
+            f"{len(info['replicas'])}/{info.get('num_replicas', '?')} "
+            "replicas")
+        for r in info["replicas"]:
+            eng = r.get("load") or {}
+            bits = [f"inflight={r.get('inflight', 0)}"]
+            for key in ("queue_depth", "active_slots", "prefilling_slots",
+                        "pool_pages_free", "pool_pages_total",
+                        "prefill_budget_util", "ttft_ewma_ms",
+                        "decode_tok_s_ewma"):
+                if key in eng:
+                    bits.append(f"{key}={eng[key]}")
+            lines.append(f"    replica {r['replica']}: " + " ".join(bits))
+    try:
+        from ray_tpu.slo import SloMonitor
+
+        # export=False: a one-shot read evaluates LIFETIME totals (no
+        # prior snapshot to window against) — informative to print, but
+        # a read-only CLI must not file slo.violation cluster events or
+        # clobber the live slo_burn_rate gauges with lifetime numbers.
+        statuses = SloMonitor(export=False).evaluate(
+            rows=state.metrics_rows())
+    except Exception as e:
+        lines.append(f"  slo: unavailable ({e})")
+        statuses = []
+    if statuses:
+        lines.append("  slo:")
+        for st in statuses:
+            if st["status"] == "no_data":
+                lines.append(f"    {st['name']}: no data")
+                continue
+            mark = "VIOLATING" if st["violating"] else "ok"
+            # A one-shot CLI read has no prior snapshot to window
+            # against; say so instead of implying a rolling-window rate.
+            span = (" over lifetime"
+                    if st.get("baseline") == "lifetime" else "")
+            lines.append(
+                f"    {st['name']}: p{int(st['quantile'] * 100)}="
+                f"{st['quantile_est_s']:.3f}s target<="
+                f"{st['threshold_s']:g}s burn={st['burn_rate']:.2f} "
+                f"[{mark}{span}]")
+    return "\n".join(lines)
 
 
 def cmd_list(args) -> None:
@@ -311,6 +375,9 @@ def main(argv: list[str] | None = None) -> None:
 
     sp = sub.add_parser("status", help="cluster summary")
     sp.add_argument("--address")
+    sp.add_argument("--serve", action="store_true",
+                    help="include serve deployments with per-replica "
+                         "engine load and SLO burn rates")
     sp.set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("list", help="list cluster state")

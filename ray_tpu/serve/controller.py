@@ -95,6 +95,7 @@ class ServeController:
             d["over_since"] = None
             d["under_since"] = None
             d["cold_ts"] = None
+            d["replica_load"] = {}
             import time as _time
 
             _now = _time.monotonic()
@@ -211,6 +212,9 @@ class ServeController:
                 "over_since": None,
                 "under_since": None,
                 "cold_ts": None,
+                # actor_id → last stats-probe payload (runtime-only; the
+                # per-replica load surface behind get_load()/status()).
+                "replica_load": {},
                 "replicas": old["replicas"] if old else [],
                 # Spawned but not yet past their first health probe —
                 # NOT in the routing table (ref: deployment_state.py
@@ -293,6 +297,34 @@ class ServeController:
                     "starting_replicas": len(d.get("starting", [])),
                     "route_prefix": d["route_prefix"],
                     "autoscaling": d.get("autoscaling"),
+                    # Last stats probe per routable replica (short id →
+                    # payload): serve.status() shows live load inline.
+                    # Short id = the ActorID's unique TAIL — the hex head
+                    # is the JobID, identical across replicas.
+                    "replica_load": {
+                        aid[-8:]: s
+                        for aid, s in (d.get("replica_load") or {}).items()
+                    },
+                }
+                for name, d in self.deployments.items()
+            }
+
+    def get_load(self) -> dict:
+        """Per-replica load table (flight recorder): the last reconcile
+        probe's stats — inflight/processed/idle plus any engine
+        load_snapshot() payload — keyed deployment → routable replica.
+        The dashboard's /api/serve/load and `ray_tpu status --serve`
+        render this; the least-loaded router will consume it."""
+        with self._lock:
+            return {
+                name: {
+                    "route_prefix": d["route_prefix"],
+                    "num_replicas": d["num_replicas"],
+                    "replicas": [
+                        {"replica": aid[-8:], "actor_id": aid,
+                         **(d.get("replica_load", {}).get(aid) or {})}
+                        for aid, _h in d["replicas"]
+                    ],
                 }
                 for name, d in self.deployments.items()
             }
@@ -423,7 +455,7 @@ class ServeController:
         with self._lock:
             snapshot = [
                 (name, d["generation"], list(d["replicas"]),
-                 list(d.get("starting", [])), bool(d.get("autoscaling")))
+                 list(d.get("starting", [])))
                 for name, d in self.deployments.items()
                 if only is None or name == only
             ]
@@ -432,23 +464,27 @@ class ServeController:
         probe_timeout = getattr(self._cfg, "serve_health_probe_timeout_s", 10.0)
         fail_limit = max(1, int(getattr(
             self._cfg, "serve_health_failure_threshold", 3)))
-        probes = []     # (name, aid, ref, wants_stats, is_starting)
-        for name, gen, replicas, starting, wants_stats in snapshot:
+        probes = []     # (name, aid, ref, is_starting)
+        for name, gen, replicas, starting in snapshot:
             for aid, handle in replicas:
+                # Serving replicas are always probed via stats() (it
+                # doubles as the health verdict): the payload now carries
+                # the engine load_snapshot the load surface + autoscaler
+                # read, so every deployment reports load, not just
+                # autoscaled ones.
                 try:
-                    ref = (handle.stats.remote() if wants_stats
-                           else handle.health.remote())
+                    ref = handle.stats.remote()
                 except Exception:  # graftlint: disable=EXC-SWALLOW (failed probe submit IS the unhealthy verdict — strikes accrue below)
                     ref = None
-                probes.append((name, aid, ref, wants_stats, False))
+                probes.append((name, aid, ref, False))
             for aid, handle, _spawned in starting:
                 try:
                     ref = handle.health.remote()
                 except Exception:  # graftlint: disable=EXC-SWALLOW (failed probe submit IS the unhealthy verdict)
                     ref = None
-                probes.append((name, aid, ref, False, True))
+                probes.append((name, aid, ref, True))
         ready_ids: set = set()
-        refs = [ref for (_n, _a, ref, _w, _s) in probes if ref is not None]
+        refs = [ref for (_n, _a, ref, _s) in probes if ref is not None]
         if refs:
             try:
                 ready, _pending = ray_tpu.wait(
@@ -459,12 +495,14 @@ class ServeController:
                 # replicas at once. That mass-unhealthy signal needs a why.
                 logger.warning("health probe wait failed (all replicas "
                                "strike this tick): %s", e)
-        # name → (gen, drop_serving, promote, drop_starting, stats)
+        # name → (gen, drop_serving, promote, drop_starting, stats) where
+        # stats is a list of (actor_id, stats-dict) pairs from serving
+        # replicas (starting replicas answer health() only).
         probed: dict[str, tuple] = {
-            name: (gen, set(), set(), set(), [] if wants_stats else None)
-            for name, gen, _r, _st, wants_stats in snapshot
+            name: (gen, set(), set(), set(), [])
+            for name, gen, _r, _st in snapshot
         }
-        for name, aid, ref, wants_stats, is_starting in probes:
+        for name, aid, ref, is_starting in probes:
             gen, drop, promote, drop_start, stats = probed[name]
             ok = False
             died = False
@@ -472,8 +510,8 @@ class ServeController:
                 try:
                     s = ray_tpu.get(ref, timeout=5)
                     ok = True
-                    if wants_stats:
-                        stats.append(s)
+                    if not is_starting:
+                        stats.append((aid, s))
                 except ActorDiedError:
                     died = True
                 except Exception:  # graftlint: disable=EXC-SWALLOW (failed probe read = unhealthy verdict; strike accrues)
@@ -508,7 +546,7 @@ class ServeController:
                     drop.add(aid)
         # Drop strike bookkeeping for replicas no longer tracked anywhere.
         if only is None:
-            seen_aids = {aid for (_n, aid, _r, _w, _s) in probes}
+            seen_aids = {aid for (_n, aid, _r, _s) in probes}
             for aid in list(self._health_fails):
                 if aid not in seen_aids:
                     del self._health_fails[aid]
@@ -545,7 +583,19 @@ class ServeController:
                     else:
                         keep_starting.append((aid, h, spawned))
                 d["starting"] = keep_starting
-                self._autoscale_decision(d, stats)
+                # Refresh the per-replica load table: new probe results
+                # win; a replica that merely missed this probe window
+                # keeps its last payload (a blank load view on one
+                # timeout would whipsaw the router); removed replicas
+                # drop out.
+                live = {aid for aid, _h in d["replicas"]}
+                merged = {aid: s
+                          for aid, s in (d.get("replica_load") or {}).items()
+                          if aid in live}
+                merged.update(
+                    {aid: s for aid, s in stats if aid in live})
+                d["replica_load"] = merged
+                self._autoscale_decision(d, [s for _aid, s in stats])
                 total = len(d["replicas"]) + len(d["starting"])
                 while total > d["num_replicas"]:
                     if d["starting"]:

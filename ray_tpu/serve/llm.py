@@ -81,6 +81,26 @@ _PREFILL_CHUNK_HIST = _profiling.Histogram(
                 0.25, 0.5, 1.0, 2.5),
     tag_keys=("replica", "impl"))
 
+# Live engine-load gauges (flight recorder): set on every load_snapshot()
+# call — the controller's stats-probe cadence — and flushed with the
+# hosting worker's metrics, so /metrics, /api/serve/load, and the
+# roadmap's least-loaded router all read the same numbers.
+_LOAD_GAUGES = {
+    key: _profiling.Gauge(f"llm_{key}", description=desc,
+                          tag_keys=("replica",))
+    for key, desc in (
+        ("queue_depth", "LLM requests queued (pending + deferred)"),
+        ("active_slots", "LLM slots bound to a request"),
+        ("prefilling_slots", "LLM slots still streaming their prompt in"),
+        ("pool_pages_free", "KV page-pool free pages"),
+        ("pool_pages_total", "KV page-pool size"),
+        ("prefill_budget_util",
+         "EWMA of per-tick prefill-budget utilization"),
+        ("ttft_ewma_ms", "EWMA of time-to-first-token (ms)"),
+        ("decode_tok_s_ewma", "EWMA of fused-window decode rate (tok/s)"),
+    )
+}
+
 
 def _request_metric_tags() -> dict:
     """Route (ingress baggage) + replica (runtime context) tags for the
@@ -95,7 +115,10 @@ def _request_metric_tags() -> dict:
 
         aid = _api.get_runtime_context().get_actor_id()
         if aid:
-            replica = aid[:8]
+            # ActorID hex = JobID(4B) + unique(8B): the head is the JOB
+            # id, shared by every replica — the unique tail is the only
+            # part that distinguishes replicas.
+            replica = aid[-8:]
     except Exception:  # graftlint: disable=EXC-SWALLOW (metric tag enrichment only; "local" is the documented fallback)
         pass
     return {"route": route, "replica": replica}
@@ -169,17 +192,30 @@ class LLMEngine:
 
         # One engine-init resolution of the jax / model-fn surface the hot
         # loop touches: _admit/step/_dispatch_chunk run every engine tick
-        # and must not re-execute import machinery per iteration.
+        # and must not re-execute import machinery per iteration. Every
+        # jitted callable goes through compile_watch.wrap so XLA compiles
+        # are attributed to the owning program at /metrics
+        # (jax_compiles_total{fn}) and per-step recompile churn trips the
+        # recompile-storm alarm instead of hiding in step-time noise.
+        from ray_tpu import compile_watch as _cw
+
+        _cw.install()
+        _w = _cw.wrap
         self._rt = types.SimpleNamespace(
             jax=jax, jnp=jnp,
-            prefill=_decode.prefill, prefill_batch=_decode.prefill_batch,
-            decode_step=_decode.decode_step,
-            decode_multi=_decode.decode_multi,
-            sample_token=_decode.sample_token,
-            prefill_batch_paged=_paged.prefill_batch_paged,
-            prefill_chunk_paged=_paged.prefill_chunk_paged,
-            decode_step_paged=_paged.decode_step_paged,
-            decode_multi_paged=_paged.decode_multi_paged,
+            prefill=_w(_decode.prefill, "prefill"),
+            prefill_batch=_w(_decode.prefill_batch, "prefill_batch"),
+            decode_step=_w(_decode.decode_step, "decode_step"),
+            decode_multi=_w(_decode.decode_multi, "decode_multi"),
+            sample_token=_w(_decode.sample_token, "sample_token"),
+            prefill_batch_paged=_w(_paged.prefill_batch_paged,
+                                   "prefill_batch_paged"),
+            prefill_chunk_paged=_w(_paged.prefill_chunk_paged,
+                                   "prefill_chunk_paged"),
+            decode_step_paged=_w(_paged.decode_step_paged,
+                                 "decode_step_paged"),
+            decode_multi_paged=_w(_paged.decode_multi_paged,
+                                  "decode_multi_paged"),
         )
         self.cfg = cfg
         self.n_slots = n_slots
@@ -275,6 +311,10 @@ class LLMEngine:
             self.slot_n_pages = np.zeros(n_slots, np.int64)
             # pop() hands out ascending ids; 0 stays reserved (null page).
             self.free_pages = list(range(n_pages, 0, -1))
+            # Low-water mark of the free list (peak pool occupancy =
+            # total - min_free): benches commit it so pool-pressure
+            # regressions show up in JSONs, not just preemption counts.
+            self._min_free_pages = n_pages
         else:
             self.cache = init_kv_cache(cfg, n_slots, max_len)
         self.tokens = np.zeros(n_slots, np.int32)
@@ -320,6 +360,13 @@ class LLMEngine:
         self._burst_step_ms: "collections.deque[float]" = collections.deque(
             maxlen=4096)
         self._last_window_end: float | None = None
+        # Load EWMAs (flight recorder): smoothed TTFT / decode-rate /
+        # prefill-budget-utilization signals for load_snapshot() — what
+        # the least-loaded router and autoscaler consume. Updated under
+        # the metrics lock at the points the raw samples already exist.
+        self._ttft_ewma_ms: float | None = None
+        self._decode_ewma_tok_s: float | None = None
+        self._budget_util_ewma: float | None = None
         self._ttft_seq = 0                    # sampled TTFT-breakdown spans
         self._step_tags: dict | None = None   # lazy: replica id + impl
         self._window_seq = 0                  # decode windows dispatched
@@ -413,6 +460,11 @@ class LLMEngine:
             self._ttft_ms.clear()
             self._burst_step_ms.clear()
             self._last_window_end = None
+            self._ttft_ewma_ms = None
+            self._decode_ewma_tok_s = None
+            self._budget_util_ewma = None
+            if self.kv_mode == "paged":
+                self._min_free_pages = len(self.free_pages)
 
     _SPAN_SAMPLE = 64
 
@@ -454,6 +506,9 @@ class LLMEngine:
             self.stats["slot_step_sum"] += k * n_active
             self.stats["slot_cap_sum"] += k * self.n_slots
             self._step_ms.append(dt / k * 1000.0)
+            if dt > 0:
+                self._decode_ewma_tok_s = self._ewma(
+                    self._decode_ewma_tok_s, k * n_active / dt)
             if tick_prefill and self._last_window_end is not None:
                 self._burst_step_ms.append(
                     (end - self._last_window_end) / k * 1000.0)
@@ -469,6 +524,7 @@ class LLMEngine:
             if self.kv_mode == "paged":
                 m["kv_pages_total"] = self.n_pages
                 m["kv_pages_free"] = len(self.free_pages)
+                m["kv_pages_free_min"] = self._min_free_pages
                 m["kv_page_size"] = self.page_size
                 m["llm_attn_impl"] = self.attn_impl
             if self.prefill_chunk:
@@ -502,6 +558,58 @@ class LLMEngine:
             m["slot_occupancy"] = m["slot_step_sum"] / m["slot_cap_sum"]
         return m
 
+    _EWMA_ALPHA = 0.2
+
+    @classmethod
+    def _ewma(cls, prev: float | None, sample: float) -> float:
+        if prev is None:
+            return sample
+        return cls._EWMA_ALPHA * sample + (1 - cls._EWMA_ALPHA) * prev
+
+    def load_snapshot(self) -> dict:
+        """Live load for the router/autoscaler (flight recorder): queue
+        depth, slot-occupancy split, page-pool fill, prefill-budget
+        utilization, and TTFT/decode-rate EWMAs — all from the engine's
+        own bookkeeping, no device sync. Also sets the `llm_*` gauges so
+        the same numbers reach /metrics via the worker's flush loop.
+        Propagation path: Replica.stats() → controller reconcile probe →
+        serve.status() / controller.get_load() / GET /api/serve/load."""
+        with self._lock:
+            active = sum(r is not None for r in self.slot_req)
+            prefilling = len(self._prefilling)
+            snap: dict = {
+                "queue_depth": self.pending.qsize() + len(self._deferred),
+                "n_slots": self.n_slots,
+                "active_slots": active,
+                "prefilling_slots": prefilling,
+                "decoding_slots": active - prefilling,
+                "slot_utilization": round(active / self.n_slots, 4),
+            }
+            if self._ttft_ewma_ms is not None:
+                snap["ttft_ewma_ms"] = round(self._ttft_ewma_ms, 3)
+            if self._decode_ewma_tok_s is not None:
+                snap["decode_tok_s_ewma"] = round(
+                    self._decode_ewma_tok_s, 3)
+            if self.kv_mode == "paged":
+                snap["pool_pages_total"] = self.n_pages
+                snap["pool_pages_free"] = len(self.free_pages)
+                snap["pool_pages_free_min"] = self._min_free_pages
+                snap["pool_utilization"] = round(
+                    1.0 - len(self.free_pages) / self.n_pages, 4)
+            if self.prefill_chunk:
+                snap["prefill_chunk"] = self.prefill_chunk
+                snap["prefill_token_budget"] = self.prefill_budget
+                if self._budget_util_ewma is not None:
+                    snap["prefill_budget_util"] = round(
+                        self._budget_util_ewma, 4)
+        tags = {"replica": self._impl_tags()["replica"]}
+        for key, gauge in _LOAD_GAUGES.items():
+            # Absent fields (dense engine's pool, EWMAs cleared by
+            # reset_stats) export 0, not their last stale value — the
+            # router must never act on a pre-reset TTFT.
+            gauge.set(float(snap.get(key, 0.0)), tags=tags)
+        return snap
+
     # --------------------------------------------------- page accounting
 
     def _pages_for(self, last_pos: int) -> int:
@@ -519,6 +627,8 @@ class LLMEngine:
             pg = self.free_pages.pop()
             self.page_table[slot, int(self.slot_n_pages[slot])] = pg
             self.slot_n_pages[slot] += 1
+        if len(self.free_pages) < self._min_free_pages:
+            self._min_free_pages = len(self.free_pages)
         return True
 
     def _free_slot_pages(self, slot: int) -> None:
@@ -577,7 +687,9 @@ class LLMEngine:
             self.stats["ttft_sum"] += now - req.submitted_at
             # Under the lock: metrics() sorts this ring concurrently.
             with self._lock:
-                self._ttft_ms.append((now - req.submitted_at) * 1000.0)
+                ms = (now - req.submitted_at) * 1000.0
+                self._ttft_ms.append(ms)
+                self._ttft_ewma_ms = self._ewma(self._ttft_ewma_ms, ms)
             self._emit_ttft_spans(req)
         req.out_ids.append(token)
         if req.stream is not None:
@@ -1021,7 +1133,17 @@ class LLMEngine:
             decode_ready = any(
                 self.slot_req[i] is not None and i not in self._chunk_pos
                 for i in range(self.n_slots))
-            self._run_prefill_chunks(decode_ready)
+            had_prefill_work = bool(self._prefilling)
+            spent = self._run_prefill_chunks(decode_ready)
+            if had_prefill_work and self.prefill_budget > 0:
+                # Budget utilization: how much of the per-tick prefill
+                # allowance ticks WITH waiting prefill work actually
+                # spend — sustained ~1.0 under queue depth means prefill
+                # throughput (not admission) is the TTFT bottleneck.
+                with self._lock:
+                    self._budget_util_ewma = self._ewma(
+                        self._budget_util_ewma,
+                        min(1.0, spent / self.prefill_budget))
         # Mid-prefill slots are not decode-active (their page tables are
         # masked off below); chunks completed this tick already graduated.
         active = [i for i in range(self.n_slots)
@@ -1262,6 +1384,11 @@ class LLMDeployment:
 
     def metrics(self) -> dict:
         return self.engine.metrics()
+
+    def load_snapshot(self) -> dict:
+        """Live engine load — picked up by Replica.stats() on every
+        controller probe, so serve.status() / /api/serve/load carry it."""
+        return self.engine.load_snapshot()
 
     def __call__(self, request: dict) -> dict:
         return self.generate(

@@ -7,12 +7,15 @@ applies reconfigure(user_config), and reports health.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any
 
 from ray_tpu import profiling, tracing
 from ray_tpu.core import serialization
+
+logger = logging.getLogger(__name__)
 
 _EXEC_LATENCY = profiling.Histogram(
     "serve_replica_execute_s",
@@ -102,12 +105,28 @@ class Replica:
         return self._inflight
 
     def stats(self) -> dict:
+        # Live engine load (flight recorder): a callable exposing
+        # load_snapshot() — e.g. LLMDeployment — rides its numbers on the
+        # controller's existing stats probe, no extra RPC.
+        load = None
+        fn = getattr(self.callable, "load_snapshot", None)
+        if fn is not None:
+            try:
+                load = fn()
+            except Exception as e:
+                # Load is advisory; the probe must still answer (it
+                # doubles as the replica health verdict).
+                logger.warning("load_snapshot failed on %s: %s",
+                               type(self.callable).__name__, e)
         with self._lock:
             idle = (0.0 if self._inflight > 0
                     else time.monotonic() - self._last_active)
-            return {"inflight": self._inflight,
-                    "processed": self._processed,
-                    "idle_s": idle}
+            out = {"inflight": self._inflight,
+                   "processed": self._processed,
+                   "idle_s": idle}
+        if load is not None:
+            out["load"] = load
+        return out
 
     def handle_request(self, method: str, args: tuple, kwargs: dict):
         dep = getattr(self, "_deployment_name", None) or type(

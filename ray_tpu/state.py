@@ -10,10 +10,13 @@ stats; no separate aggregator process is needed at this scale.
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Any
 
 from ray_tpu.core import rpc
 from ray_tpu.core.config import Config
+
+logger = logging.getLogger(__name__)
 
 
 def _gcs_address() -> tuple[str, int]:
@@ -75,6 +78,39 @@ def list_cluster_events(after_seq: int = 0,
     if return_latest_seq:
         return resp["events"], resp.get("latest_seq", 0)
     return resp["events"]
+
+
+def emit_cluster_event(type_: str, message: str, *,
+                       severity: str = "INFO", source: str = "driver",
+                       **extra) -> bool:
+    """Append one structured record to the GCS cluster event log — the
+    write half of `list_cluster_events` (library alarms like
+    `recompile.storm` / `slo.violation` land here). Best-effort by
+    contract: returns False when no client is attached or the GCS call
+    fails — emitting an event must never take down the code path that
+    observed it."""
+    try:
+        import os
+
+        from ray_tpu import api as _api
+
+        client = _api._client
+        if client is None and os.environ.get("RAY_TPU_GCS_ADDRESS") \
+                and os.environ.get("RAY_TPU_RAYLET_ADDRESS"):
+            # Inside a cluster worker that hasn't touched the client API
+            # yet (e.g. a recompile storm during a serve replica's
+            # cold-start warmup — the most storm-prone window): attach is
+            # cheap and the alarm is the point. Clusterless processes
+            # stay excluded — an alarm must never auto-START a cluster.
+            client = _api._ensure_client()
+        if client is None:
+            return False
+        client.event_add({"type": type_, "message": message,
+                          "severity": severity, "source": source, **extra})
+        return True
+    except Exception as e:
+        logger.debug("cluster event %s not delivered: %s", type_, e)
+        return False
 
 
 def _profile_events() -> tuple[list[dict], int]:
