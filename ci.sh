@@ -49,6 +49,7 @@ case "$TIER" in
       tests/test_rllib_eval.py        # RLlib: eval workers + callbacks
       tests/test_sharding_audit.py    # SPMD audit arithmetic
       tests/test_graftlint.py         # static-analysis rules + baseline
+      tests/test_graftlint_v2.py      # flow-aware families + compat shim
       tests/test_flight_recorder.py   # compile watch / load / SLO
     ) ;;
   *) echo "usage: $0 [fast|full|quick]" >&2; exit 2 ;;
@@ -63,7 +64,7 @@ esac
 # fallback instead of importorskip'ing).
 for guarded in tests/test_tracing.py tests/test_paged_attention.py \
                tests/test_chunked_prefill.py tests/test_graftlint.py \
-               tests/test_flight_recorder.py; do
+               tests/test_graftlint_v2.py tests/test_flight_recorder.py; do
   collected=$(python -m pytest "${guarded}" --collect-only -q \
     -p no:cacheprovider 2>/dev/null | grep -c "^${guarded}" || true)
   if [ "${collected}" -eq 0 ]; then
@@ -73,17 +74,20 @@ for guarded in tests/test_tracing.py tests/test_paged_attention.py \
 done
 
 # Static analysis gate (fast/quick tiers, before pytest): graftlint over
-# the runtime against the committed baseline — a NEW jit-closure,
-# blocked-event-loop, or swallowed-exception hazard fails the tier before
-# any test runs. Degrades gracefully on trees without a committed
-# baseline (fresh forks): advisory-only, since every historical finding
-# would read as "new" there.
+# the runtime AND its own tooling against the committed baseline — a NEW
+# jit-closure, recompile-hazard, shard-spec, jax-compat,
+# blocked-event-loop, or swallowed-exception finding fails the tier
+# before any test runs. The summary prints per-rule-family counts
+# (total/baselined/new), so baseline drift between runs is visible
+# straight from CI logs. Degrades gracefully on trees without a
+# committed baseline (fresh forks): advisory-only, since every
+# historical finding would read as "new" there.
 if [ "$TIER" = "fast" ] || [ "$TIER" = "quick" ]; then
   if [ -f tools/graftlint/baseline.json ]; then
-    python -m tools.graftlint ray_tpu/
+    python -m tools.graftlint ray_tpu/ tools/
   else
     echo "ci.sh: no graftlint baseline committed — advisory lint only" >&2
-    python -m tools.graftlint ray_tpu/ || true
+    python -m tools.graftlint ray_tpu/ tools/ || true
   fi
 fi
 

@@ -673,6 +673,14 @@ def main() -> None:
     from ray_tpu.utils.lazy_axon import install as _lazy_axon_install
 
     _lazy_axon_install()
+    # Workers compile + read persistent-cache entries too (env-inherited
+    # JAX_COMPILATION_CACHE_DIR). The hook patches jax's cache the moment
+    # task code first imports jax — no eager jax import (seconds per
+    # worker start), no task-boundary gap (a single long task that
+    # imports jax is covered before its first compile).
+    from ray_tpu.utils.platform import harden_jax_compilation_cache_on_import
+
+    harden_jax_compilation_cache_on_import()
     logging.basicConfig(level=logging.INFO,
                         format="[worker] %(levelname)s %(message)s")
     rhost, rport = args.raylet.rsplit(":", 1)

@@ -21,10 +21,12 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Callable
 
-import jax
+import jax  # noqa: F401  (device backend init for callers)
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.utils.jax_compat import shard_map
 
 
 def pipeline_apply(
@@ -52,7 +54,7 @@ def pipeline_apply(
     assert B % n_micro == 0, (B, n_micro)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         axis_names={axis},
         in_specs=(P(axis), P()),

@@ -34,6 +34,7 @@ from ray_tpu.ops.attention import (
     flash_attention,
     reference_attention,
 )
+from ray_tpu.utils.jax_compat import shard_map
 
 
 def _combine(o1, lse1, o2, lse2):
@@ -139,7 +140,7 @@ def ring_attention_sharded(
         ring_attention, axis_name=axis_name, causal=causal,
         sm_scale=sm_scale, impl=impl,
     )
-    return jax.shard_map(
+    return shard_map(
         lambda a, b, c: fn(a, b, c),
         mesh=mesh,
         in_specs=(spec, spec, spec),

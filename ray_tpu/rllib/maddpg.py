@@ -299,6 +299,9 @@ class MADDPG:
                     self._key, sub = jax.random.split(self._key)
                     (self.actors[i], self.critics[i], self.os_a[i],
                      self.os_c[i], cl, al) = self._update(
+                        # Static agent index is deliberate per-agent jit
+                        # specialization: exactly self.n executables.
+                        # graftlint: disable=RECOMPILE-HAZARD (bounded by n agents, compiled once each)
                         i, self.actors[i], self.critics[i], self.os_a[i],
                         self.os_c[i], self.t_actors, self.t_critics[i],
                         dev, sub)

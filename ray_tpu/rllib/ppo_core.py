@@ -66,4 +66,9 @@ def make_sgd_epoch(policy, optimizer, hp: PPOHyperparams):
             step, (params, opt_state), minibatches, unroll=True)
         return params, opt_state, losses, infos
 
+    # NB the persistent compile cache must never serve this program:
+    # jaxlib 0.4.x CPU corrupts the heap deserializing it back on a warm
+    # run. The harness-level cache patch blocklists `jit_epoch-*` keys —
+    # see utils/platform.harden_jax_compilation_cache. Renaming `epoch`
+    # means renaming the blocklist entry.
     return jax.jit(epoch, donate_argnums=(0, 1))

@@ -134,7 +134,9 @@ class RecurrentQGreedyActor:
         import jax.numpy as jnp
 
         if self._step is None:
-            self._step = jax.jit(rq_step)
+            # Donate the LSTM carry: every caller passes fresh
+            # jnp.asarray temporaries and keeps its own host copy.
+            self._step = jax.jit(rq_step, donate_argnums=(2, 3))
         N = obs.shape[0]
         if self._h is None or self._h.shape[0] != N:
             self._h = np.zeros((N, self.lstm), np.float32)
@@ -171,7 +173,7 @@ class R2D2Sampler:
         self.L = seq_len
         self.stride = stride
         self.lstm = lstm
-        self._step = jax.jit(rq_step)
+        self._step = jax.jit(rq_step, donate_argnums=(2, 3))
         self.params = None
         self._rng = np.random.default_rng(seed)
         N = self.env.num_envs
@@ -500,7 +502,7 @@ class R2D2(Algorithm):
 
         cfg: R2D2Config = self.config
         env = make_env(cfg.env, num_envs=1, seed=seed)
-        step = jax.jit(rq_step)
+        step = jax.jit(rq_step, donate_argnums=(2, 3))
         returns = []
         for _ in range(episodes):
             obs = env.reset().reshape(1, -1)

@@ -77,7 +77,9 @@ class RecurrentPolicy:
         }
         if not self.discrete:
             self.params["log_std"] = jnp.zeros((act_dim,), jnp.float32)
-        self._step = jax.jit(self._step_impl)
+        # Donate the LSTM carry (argnums are post-self: params=0 … c=3);
+        # compute_actions passes fresh jnp.asarray temporaries.
+        self._step = jax.jit(self._step_impl, donate_argnums=(2, 3))
 
     def initial_state(self, n: int) -> tuple[np.ndarray, np.ndarray]:
         return (np.zeros((n, self.hidden), np.float32),

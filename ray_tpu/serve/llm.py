@@ -1242,6 +1242,10 @@ class LLMEngine:
     def _loop(self) -> None:
         try:
             while not self._shutdown.is_set():
+                # step() IS the host-side scheduler tick: it syncs once
+                # per multi-token decode window by design, amortized over
+                # llm_decode_block tokens — see BENCH_SERVE.md.
+                # graftlint: disable=HOST-SYNC-IN-HOT-LOOP (designed once-per-window sync point)
                 n = self.step()
                 if n == 0 and self.pending.empty() and not self._deferred:
                     # Idle: block briefly instead of spinning.

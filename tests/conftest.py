@@ -11,7 +11,10 @@ __graft_entry__.py) — it must run before any backend is initialized.
 
 import os
 
-from ray_tpu.utils.platform import force_cpu_devices
+from ray_tpu.utils.platform import (
+    force_cpu_devices,
+    harden_jax_compilation_cache,
+)
 
 force_cpu_devices(8)
 
@@ -29,6 +32,13 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 # Subprocesses (workers, multi-process train backends) inherit via env.
 os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+
+# A run hard-killed mid-cache-write (the tier runner's timeout SIGKILL,
+# an XLA CHECK-failure abort) can tear a `-cache` entry that later
+# deserializes into heap corruption — see harden_jax_compilation_cache.
+# Workers apply the same patch in their own processes (worker.py main).
+harden_jax_compilation_cache()
 # Machine-persistent pip runtime-env cache: the venv-build test costs ~60s
 # per fresh session dir; content-addressed digests make reuse safe.
 os.environ.setdefault("RAY_TPU_PIP_ENV_CACHE_DIR",

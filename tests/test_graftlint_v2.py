@@ -1,0 +1,526 @@
+"""graftlint v2: flow-aware rule families + the shard_map compat shim.
+
+Covers, per ISSUE 7:
+- RECOMPILE-HAZARD / SHARD-SPEC / JAX-COMPAT: one true-positive AND one
+  clean fixture each;
+- call-graph one-hop resolution: a helper-wrapped hazard is caught, a
+  two-hop chain is explicitly OUT of scope;
+- SHARD-SPEC unknown-axis / arity / duplicate-axis / donate-alias;
+- JAX-COMPAT version-range gating (fires only when the version predicate
+  says the symbol is absent);
+- baseline refusal for the new families under ray_tpu/core|serve;
+- the CLI catches a seeded unknown-mesh-axis PartitionSpec and a seeded
+  scalar-varying jit call site (acceptance criteria, end to end);
+- ray_tpu.utils.jax_compat.shard_map runs on the installed JAX.
+
+Fixtures are linted through the real engine, same code path as
+`python -m tools.graftlint`.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint import jax_compat as compat_table
+from tools.graftlint.engine import Finding, lint_paths
+from tools.graftlint.rules import RULES_BY_ID, V2_FAMILIES
+from tools.graftlint.rules.compat import JaxCompatRule
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def lint_src(tmp_path: Path, src: str, rules, name="fix.py"):
+    f = tmp_path / name
+    f.write_text(src)
+    return lint_paths([str(f)], rules)
+
+
+def rule_ids(res):
+    return {f.rule for f in res.findings}
+
+
+# ------------------------------------------------- RECOMPILE-HAZARD
+
+RECOMPILE = [RULES_BY_ID["RECOMPILE-HAZARD"]]
+
+
+def test_recompile_static_varying_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+step = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+
+def drive(batches):
+    return [step(b, len(b)) for b in batches]
+""", RECOMPILE)
+    assert "RECOMPILE-HAZARD" in rule_ids(res)
+    assert any("len(...)" in f.message for f in res.findings)
+
+
+def test_recompile_static_argnames_loop_var_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+step = jax.jit(lambda x, width: x, static_argnames=("width",))
+
+def drive(x, widths):
+    for w in widths:
+        step(x, width=w)
+""", RECOMPILE)
+    assert any("loop variable" in f.message for f in res.findings)
+
+
+def test_recompile_clean_constant_static(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+step = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+BUCKET = 128
+
+def drive(batches):
+    return [step(b, BUCKET) for b in batches]
+""", RECOMPILE)
+    assert res.findings == []
+
+
+def test_recompile_kwargs_splat_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+step = jax.jit(lambda x, a=0, b=0: x + a + b)
+
+def drive(x, kw):
+    return step(x, **kw)
+""", RECOMPILE)
+    assert any("dict order" in f.message for f in res.findings)
+
+
+def test_recompile_shape_varying_slice_in_loop_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+fwd = jax.jit(lambda x: x.sum())
+
+def drive(x, lengths):
+    out = []
+    for n in lengths:
+        out.append(fwd(x[:n]))
+    return out
+""", RECOMPILE)
+    assert any("slice" in f.message for f in res.findings)
+
+
+def test_recompile_helper_jit_in_loop_one_hop_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+def make_step(scale):
+    return jax.jit(lambda x: x * scale)
+
+def train(batches):
+    out = []
+    for b in batches:
+        out.append(make_step(2.0)(b))
+    return out
+""", RECOMPILE)
+    assert any("call-hop" in f.message for f in res.findings)
+
+
+def test_recompile_helper_two_hop_out_of_scope(tmp_path):
+    # make_step is TWO hops from the loop: deliberately not chased.
+    res = lint_src(tmp_path, """\
+import jax
+
+def make_step(scale):
+    return jax.jit(lambda x: x * scale)
+
+def outer(scale):
+    return make_step(scale)
+
+def train(batches):
+    out = []
+    for b in batches:
+        out.append(outer(2.0)(b))
+    return out
+""", RECOMPILE)
+    assert res.findings == []
+
+
+def test_recompile_clean_hoisted_helper(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+def make_step(scale):
+    return jax.jit(lambda x: x * scale)
+
+def train(batches):
+    step = make_step(2.0)
+    return [step(b) for b in batches]
+""", RECOMPILE)
+    assert res.findings == []
+
+
+# ----------------------------------------- one-hop closure / host-sync
+
+def test_jit_closure_one_hop_through_helper(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+import jax.numpy as jnp
+
+SCALE = jnp.array([1.0, 2.0])
+
+def apply_scale(x):
+    return x * SCALE
+
+@jax.jit
+def fwd(x):
+    return apply_scale(x) + 1
+""", [RULES_BY_ID["JIT-CLOSURE"]])
+    assert any("one call-hop" in f.message for f in res.findings)
+
+
+def test_jit_closure_two_hop_out_of_scope(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+import jax.numpy as jnp
+
+SCALE = jnp.array([1.0, 2.0])
+
+def inner(x):
+    return x * SCALE
+
+def middle(x):
+    return inner(x)
+
+@jax.jit
+def fwd(x):
+    return middle(x) + 1
+""", [RULES_BY_ID["JIT-CLOSURE"]])
+    assert res.findings == []
+
+
+def test_host_sync_one_hop_through_helper(tmp_path):
+    res = lint_src(tmp_path, """\
+import numpy as np
+
+def read_logits(engine):
+    return np.asarray(engine.logits())
+
+def decode_tokens(engine, n):
+    toks = []
+    while len(toks) < n:
+        toks.append(read_logits(engine).argmax())
+    return toks
+""", [RULES_BY_ID["HOST-SYNC-IN-HOT-LOOP"]])
+    assert any("one call-hop" in f.message for f in res.findings)
+
+
+def test_host_sync_one_hop_skips_recursion_and_clean_helper(tmp_path):
+    # `step` calling env.step must not resolve to ITSELF (recursion /
+    # same-named method on another object), and a helper without a sync
+    # stays clean.
+    res = lint_src(tmp_path, """\
+import numpy as np
+
+def pack(x):
+    return [x]
+
+def step(env, actions):
+    for a in actions:
+        env.step(pack(a))
+    return env
+""", [RULES_BY_ID["HOST-SYNC-IN-HOT-LOOP"]])
+    assert res.findings == []
+
+
+# ------------------------------------------------------- SHARD-SPEC
+
+SHARD = [RULES_BY_ID["SHARD-SPEC"]]
+
+
+def test_shard_spec_unknown_axis_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+spec = P("dp", "mp")
+""", SHARD)
+    assert any("unknown axis" in f.message or "`mp`" in f.message
+               for f in res.findings)
+
+
+def test_shard_spec_unknown_axis_meshconfig_vocabulary(tmp_path):
+    # The repo's own MeshConfig(dp=..., tp=...) declares the vocabulary.
+    res = lint_src(tmp_path, """\
+from jax.sharding import PartitionSpec as P
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh
+
+mesh = make_mesh(MeshConfig(dp=2, tp=4))
+bad = P("fsdp")
+""", SHARD)
+    assert len(res.findings) == 1
+
+
+def test_shard_spec_no_mesh_in_file_skips_axis_check(tmp_path):
+    # Mesh comes in as a parameter: the axis vocabulary is unknowable.
+    res = lint_src(tmp_path, """\
+from jax.sharding import PartitionSpec as P
+
+def make_specs():
+    return P(("dp", "fsdp"), "sp", "tp", None)
+""", SHARD)
+    assert res.findings == []
+
+
+def test_shard_spec_duplicate_axis_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+from jax.sharding import PartitionSpec as P
+
+spec = P(("dp", "x"), "dp")
+""", SHARD)
+    assert any("twice" in f.message for f in res.findings)
+
+
+def test_shard_spec_arity_mismatch_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from ray_tpu.utils.jax_compat import shard_map
+
+mesh = Mesh(jax.devices(), ("dp",))
+y = shard_map(lambda a, b: a + b, mesh=mesh,
+              in_specs=(P("dp"),), out_specs=P("dp"))
+""", SHARD)
+    assert any("positional argument" in f.message for f in res.findings)
+
+
+def test_shard_spec_clean(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from ray_tpu.utils.jax_compat import shard_map
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+spec = P("dp", "tp")
+y = shard_map(lambda a, b: a + b, mesh=mesh,
+              in_specs=(P("dp"), P("dp")), out_specs=P("dp"))
+""", SHARD)
+    assert res.findings == []
+
+
+def test_shard_spec_donate_alias_fires_and_rebind_is_clean(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax
+
+update = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+
+def bad(params, grads):
+    new = update(params, grads)
+    stale = params + 1
+    return new, stale
+
+def good(params, grads):
+    params = update(params, grads)
+    return params + 1
+""", SHARD)
+    assert len(res.findings) == 1
+    assert "donated" in res.findings[0].message
+
+
+def test_shard_spec_donate_alias_multiline_call_is_clean(tmp_path):
+    # The repo's own idiom: donated args on the call's continuation line,
+    # rebound by the same statement — must NOT read as use-after-donate.
+    res = lint_src(tmp_path, """\
+import jax
+
+update = jax.jit(lambda p, o, b: (p, o), donate_argnums=(0, 1))
+
+class T:
+    def train_once(self, batch):
+        (self.params, self.opt_state) = update(
+            self.params, self.opt_state, batch)
+        return self.params
+""", SHARD)
+    assert res.findings == []
+
+
+# -------------------------------------------------------- JAX-COMPAT
+
+def test_jax_compat_fires_only_when_version_predicate_says_absent(
+        tmp_path):
+    src = """\
+import jax
+
+def wrap(f, mesh, spec):
+    return jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+"""
+    old = lint_src(tmp_path, src, [JaxCompatRule(version="0.4.37")])
+    assert len(old.findings) == 1
+    assert "ray_tpu.utils.jax_compat.shard_map" in old.findings[0].message
+
+    new = lint_src(tmp_path, src, [JaxCompatRule(version="0.6.2")],
+                   name="new.py")
+    assert new.findings == []
+
+
+def test_jax_compat_removed_symbol_gates_the_other_way(tmp_path):
+    src = """\
+import jax
+
+def flatten(t):
+    return jax.tree_map(lambda x: x, t)
+"""
+    # Present (deprecated) in 0.4.x: quiet. Removed in 0.6: fires.
+    assert lint_src(tmp_path, src,
+                    [JaxCompatRule(version="0.4.37")]).findings == []
+    res = lint_src(tmp_path, src, [JaxCompatRule(version="0.6.0")],
+                   name="new.py")
+    assert len(res.findings) == 1
+    assert "jax.tree.map" in res.findings[0].message
+
+
+def test_jax_compat_import_forms_caught(tmp_path):
+    res = lint_src(tmp_path, """\
+from jax import shard_map
+from jax.experimental.maps import xmap
+""", [JaxCompatRule(version="0.4.37")])
+    assert len(res.findings) == 2
+
+
+def test_jax_compat_getattr_string_access_is_clean(tmp_path):
+    # The sanctioned compat idiom (the shim itself) must not fire.
+    res = lint_src(tmp_path, """\
+import jax
+
+native = getattr(jax, "shard_map", None)
+has = hasattr(jax, "tree_map")
+""", [JaxCompatRule(version="0.9.0")])
+    assert res.findings == []
+
+
+def test_jax_compat_version_parse_and_predicate():
+    sm = compat_table.BY_DOTTED["jax.shard_map"]
+    assert compat_table.absent_in(sm, "0.4.37")
+    assert not compat_table.absent_in(sm, "0.6.0")
+    assert not compat_table.absent_in(sm, "0.7.1.dev20+gdeadbeef")
+    tm = compat_table.BY_DOTTED["jax.tree_map"]
+    assert not compat_table.absent_in(tm, "0.4.37")
+    assert compat_table.absent_in(tm, "0.6.0")
+    assert compat_table.parse_version("0.6") == (0, 6, 0)
+
+
+# --------------------------------------------- baseline: new families
+
+def test_baseline_refuses_new_families_in_core_and_serve(tmp_path):
+    findings = [
+        Finding(rule=fam, path=f"ray_tpu/{plane}/x.py", line=1, col=0,
+                message="m", fingerprint=f"{fam}-{plane}")
+        for fam in V2_FAMILIES for plane in ("core", "serve")
+    ] + [Finding(rule="SHARD-SPEC", path="ray_tpu/rllib/es.py",
+                 line=1, col=0, message="m", fingerprint="ok")]
+    bl = tmp_path / "bl.json"
+    written, refused = baseline_mod.write(findings, bl)
+    assert written == 1                      # only the rllib finding
+    assert len(refused) == 2 * len(V2_FAMILIES)
+    assert baseline_mod.load(bl) == {"ok": 1}
+
+
+def test_committed_baseline_has_no_v2_family_entries():
+    # The acceptance bar: the new families were fixed or justified, not
+    # grandfathered — anywhere, not just core/serve.
+    rules = {e["rule"] for e in baseline_mod.load_entries()}
+    assert not (rules & set(V2_FAMILIES)), rules & set(V2_FAMILIES)
+
+
+# ------------------------------------------------------ CLI acceptance
+
+def _run_cli(*args, env_extra=None, cwd=REPO_ROOT):
+    import os
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        capture_output=True, text=True, cwd=cwd, env=env)
+
+
+def test_cli_catches_seeded_unknown_axis_and_scalar_varying_jit(tmp_path):
+    seeded = tmp_path / "seeded.py"
+    seeded.write_text("""\
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("dp", "tp"))
+spec = P("dp", "mp")
+
+step = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+
+def drive(batches):
+    return [step(b, len(b)) for b in batches]
+""")
+    p = _run_cli(str(seeded), "--no-baseline")
+    assert p.returncode == 1
+    assert "SHARD-SPEC" in p.stdout and "`mp`" in p.stdout
+    assert "RECOMPILE-HAZARD" in p.stdout and "len(...)" in p.stdout
+
+
+def test_cli_jax_compat_env_version_gate(tmp_path):
+    f = tmp_path / "compat.py"
+    f.write_text("import jax\n\ny = jax.tree_map\n")
+    fires = _run_cli(str(f), "--no-baseline",
+                     env_extra={"GRAFTLINT_JAX_VERSION": "0.6.0"})
+    assert fires.returncode == 1 and "JAX-COMPAT" in fires.stdout
+    quiet = _run_cli(str(f), "--no-baseline",
+                     env_extra={"GRAFTLINT_JAX_VERSION": "0.4.37"})
+    assert quiet.returncode == 0
+
+
+def test_cli_per_family_counts_in_output(tmp_path):
+    f = tmp_path / "fam.py"
+    f.write_text("""\
+from jax.sharding import PartitionSpec as P
+
+spec = P("dp", "dp")
+""")
+    p = _run_cli(str(f), "--no-baseline")
+    assert "SHARD-SPEC" in p.stdout
+    assert "total=1" in p.stdout and "new=1" in p.stdout
+    j = _run_cli(str(f), "--no-baseline", "--json")
+    import json
+    doc = json.loads(j.stdout)
+    assert doc["by_rule"]["SHARD-SPEC"]["new"] == 1
+
+
+@pytest.mark.slow
+def test_repo_and_tools_tree_clean_against_baseline():
+    p = _run_cli("ray_tpu/", "tools/")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+# ------------------------------------------------- compat shim runtime
+
+def test_shim_shard_map_runs_on_installed_jax():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ray_tpu.utils.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    f = shard_map(lambda a: a * 2, mesh=mesh, in_specs=(P(),),
+                  out_specs=P(), check_vma=False)
+    out = f(jnp.arange(4.0))
+    np.testing.assert_allclose(np.asarray(out), [0.0, 2.0, 4.0, 6.0])
+
+
+def test_shim_tree_map_spans_versions():
+    from ray_tpu.utils.jax_compat import tree_map
+
+    assert tree_map(lambda x: x + 1, {"a": 1, "b": (2, 3)}) == \
+        {"a": 2, "b": (3, 4)}

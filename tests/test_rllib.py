@@ -38,11 +38,29 @@ class TestDistributedRollouts:
                .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
                          rollout_fragment_length=32)
                .training(num_sgd_iter=2, sgd_minibatch_size=64))
+        import os as _os
+        cache_dir = _os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
+
+        def _epoch_entries():
+            if not (cache_dir and _os.path.isdir(cache_dir)):
+                return set()
+            return {f for f in _os.listdir(cache_dir)
+                    if f.startswith("jit_epoch-")}
+
+        pre = _epoch_entries()
         algo = cfg.build()
         r1 = algo.train()
         r2 = algo.train()
         assert r2["timesteps_total"] == 2 * 2 * 2 * 32  # workers*envs*frag*it
         assert np.isfinite(r2["total_loss"])
+        # The sgd epoch program must never land in the persistent compile
+        # cache: jaxlib 0.4.x CPU corrupts the heap deserializing it back
+        # on the next warm run (platform.harden_jax_compilation_cache
+        # blocklists the key for both get and put). This test IS the
+        # warm-read crash repro when that guard regresses.
+        assert _epoch_entries() <= pre, \
+            "PPO epoch executable was persisted — warm-cache runs of " \
+            "this test will segfault (cache key blocklist lost)"
         algo.stop()
 
 

@@ -165,3 +165,51 @@ class TestCheckSerialize:
         ok, failures = inspect_serializability(f)
         assert not ok
         assert any(fail.name == "lock" for fail in failures), failures
+
+
+class TestJaxCacheHardening:
+    """utils.platform.harden_jax_compilation_cache: atomic entry writes
+    plus the poisonous-executable key blocklist (conftest applies the
+    patch process-wide; these pin its mechanics against jax upgrades)."""
+
+    def _cache_cls(self):
+        pytest.importorskip("jax")
+        from ray_tpu.utils.platform import harden_jax_compilation_cache
+
+        harden_jax_compilation_cache()   # idempotent
+        from jax._src import lru_cache as _lru
+
+        assert getattr(_lru.LRUCache.put, "_ray_tpu_atomic", False), \
+            "conftest should have patched LRUCache already"
+        return _lru.LRUCache
+
+    def test_put_is_atomic_and_roundtrips(self, tmp_path):
+        c = self._cache_cls()(str(tmp_path), max_size=-1)
+        c.put("jit_fwd-aa11", b"executable-blob")
+        assert c.get("jit_fwd-aa11") == b"executable-blob"
+        # No tmp debris after a clean put, and the entry is a real file
+        # (rename landed).
+        assert not list(tmp_path.glob("*.tmp"))
+        assert any(f.name.startswith("jit_fwd-aa11") and
+                   f.name.endswith("-cache") for f in tmp_path.iterdir())
+
+    def test_blocklisted_keys_never_stored_or_served(self, tmp_path):
+        c = self._cache_cls()(str(tmp_path), max_size=-1)
+        c.put("jit_epoch-deadbeef", b"poison")
+        assert not any("jit_epoch" in f.name for f in tmp_path.iterdir())
+        # A pre-existing entry (written by a pre-fix run) is never READ
+        # either — the deserialization crash needs the bytes to reach
+        # XLA, and they must not.
+        (tmp_path / "jit_epoch-deadbeef-cache").write_bytes(b"poison")
+        assert c.get("jit_epoch-deadbeef") is None
+
+    def test_blocklist_env_extension(self, tmp_path, monkeypatch):
+        c = self._cache_cls()(str(tmp_path), max_size=-1)
+        # comma-space style must work: entries are stripped.
+        monkeypatch.setenv("RAY_TPU_JAX_CACHE_BLOCKLIST",
+                           "jit_other-, jit_bad-")
+        c.put("jit_bad-0011", b"x")
+        assert c.get("jit_bad-0011") is None
+        monkeypatch.delenv("RAY_TPU_JAX_CACHE_BLOCKLIST")
+        c.put("jit_good-0011", b"y")
+        assert c.get("jit_good-0011") == b"y"

@@ -96,6 +96,14 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         return 0
 
+    by_rule: dict[str, dict[str, int]] = {}
+    for f in result.findings:
+        d = by_rule.setdefault(f.rule, {"total": 0, "baselined": 0,
+                                        "new": 0})
+        d["total"] += 1
+        d["baselined"] += f.baselined
+        d["new"] += not f.baselined
+
     if args.as_json:
         print(json.dumps({
             "version": 1,
@@ -103,6 +111,7 @@ def main(argv: list[str] | None = None) -> int:
             "suppressed": result.suppressed,
             "parse_errors": result.parse_errors,
             "new_count": len(result.new_findings),
+            "by_rule": by_rule,
         }, indent=1))
     else:
         for f in result.findings:
@@ -114,6 +123,13 @@ def main(argv: list[str] | None = None) -> int:
         print(f"graftlint: {len(result.findings)} finding(s) "
               f"({n_base} baselined, {result.suppressed} suppressed, "
               f"{len(result.new_findings)} new)")
+        # Per-family counts on one greppable line each: CI logs diff
+        # these across runs, so baseline drift is visible without
+        # opening baseline.json.
+        for rule_id in sorted(by_rule):
+            d = by_rule[rule_id]
+            print(f"graftlint:   {rule_id:24s} total={d['total']} "
+                  f"baselined={d['baselined']} new={d['new']}")
 
     if result.parse_errors:
         return 2

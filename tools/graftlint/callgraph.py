@@ -1,0 +1,176 @@
+"""Per-module call graph: local defs, jit-wrapped bindings, one-hop calls.
+
+This is the flow-aware substrate under graftlint v2. It stays *lexical*
+and *per-module* on purpose — graftlint has no import resolver and no
+type inference — but one level of name resolution is enough to close the
+gap the v1 per-function rules left open: a hot loop that calls a local
+helper which does the host sync, a jitted body that reaches an array
+global through a helper, a loop that calls a factory which builds a
+fresh ``jax.jit`` per invocation.
+
+Resolution contract (shared by every caller):
+
+- A call by bare name resolves to every local ``def`` of that name.
+- A call through an attribute (``self._step(...)``, ``mod.helper(...)``)
+  resolves by the *trailing* attribute name — same heuristic the v1
+  jit collector uses for ``jax.jit(self.method)``.
+- Exactly ONE hop: rules look inside a resolved helper's body but never
+  chase the helper's own calls. Two-hop chains are out of scope by
+  design (kept cheap, kept predictable; see test_graftlint_v2).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.graftlint.rules._shared import (
+    _decorator_jit_keywords,
+    is_jit_construction,
+    jit_call_parts,
+)
+
+
+def _const_ints(node: ast.AST | None) -> tuple[int, ...]:
+    """Literal int / tuple-or-list-of-int keyword value → ints; anything
+    non-literal (computed argnums) → empty, i.e. "unknown, stay quiet"."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int) \
+                    and not isinstance(el.value, bool):
+                out.append(el.value)
+            else:
+                return ()
+        return tuple(out)
+    return ()
+
+
+def _const_strs(node: ast.AST | None) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return ()
+        return tuple(out)
+    return ()
+
+
+@dataclasses.dataclass
+class JitBinding:
+    """A name the module binds to a jit-wrapped callable, with the cache-
+    key-relevant keywords lifted out of the wrapping call."""
+
+    name: str                       # bare name or attribute tail
+    site: ast.AST                   # the jit(...) construction / def node
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+    target: ast.FunctionDef | ast.Lambda | None = None
+
+
+def _keywords_of_interest(kws: list[ast.keyword]) -> dict:
+    out: dict = {"static_argnums": (), "static_argnames": (),
+                 "donate_argnums": ()}
+    for kw in kws:
+        if kw.arg == "static_argnums":
+            out["static_argnums"] = _const_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            out["static_argnames"] = _const_strs(kw.value)
+        elif kw.arg in ("donate_argnums",):
+            out["donate_argnums"] = _const_ints(kw.value)
+    return out
+
+
+class ModuleGraph:
+    """Built once per file (cache it via ``module_graph(ctx)``)."""
+
+    def __init__(self, tree: ast.AST):
+        self.defs: dict[str, list[ast.FunctionDef]] = {}
+        self.jit_bindings: dict[str, list[JitBinding]] = {}
+        self._collect(tree)
+
+    # ------------------------------------------------------------ build
+
+    def _collect(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+        def bind(name: str, call_or_def, kws: list[ast.keyword],
+                 target: ast.AST | None) -> None:
+            info = _keywords_of_interest(kws)
+            tgt = None
+            if isinstance(target, ast.Lambda):
+                tgt = target
+            elif isinstance(target, ast.Name):
+                cands = self.defs.get(target.id, [])
+                tgt = cands[0] if cands else None
+            elif isinstance(target, ast.Attribute):
+                cands = self.defs.get(target.attr, [])
+                tgt = cands[0] if cands else None
+            self.jit_bindings.setdefault(name, []).append(JitBinding(
+                name=name, site=call_or_def, target=tgt, **info))
+
+        for node in ast.walk(tree):
+            # name = jax.jit(f, ...) / self._step = jax.jit(...)
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                tgt_expr, kws = jit_call_parts(node.value)
+                if tgt_expr is None and not is_jit_construction(node.value):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        bind(t.id, node.value, kws, tgt_expr)
+                    elif isinstance(t, ast.Attribute):
+                        bind(t.attr, node.value, kws, tgt_expr)
+            # @jax.jit / @partial(jax.jit, ...) decorated defs
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    kws = _decorator_jit_keywords(dec)
+                    if kws is not None:
+                        info = _keywords_of_interest(kws)
+                        self.jit_bindings.setdefault(node.name, []).append(
+                            JitBinding(name=node.name, site=node,
+                                       target=node, **info))
+
+    # ---------------------------------------------------------- queries
+
+    def _callee_name(self, call: ast.Call) -> str | None:
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        return None
+
+    def resolve_call(self, call: ast.Call) -> list[ast.FunctionDef]:
+        """One-hop: the local defs a call site can reach by name."""
+        name = self._callee_name(call)
+        return list(self.defs.get(name, [])) if name else []
+
+    def jit_bindings_for_call(self, call: ast.Call) -> list[JitBinding]:
+        """Bindings whose name matches the callee (bare or attr tail)."""
+        name = self._callee_name(call)
+        return list(self.jit_bindings.get(name, [])) if name else []
+
+    def constructs_jit(self, fn: ast.FunctionDef) -> ast.Call | None:
+        """First jit construction anywhere in `fn`'s own body (used for
+        the interprocedural jit-in-loop check); None if clean."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and is_jit_construction(node):
+                return node
+        return None
+
+
+def module_graph(ctx) -> ModuleGraph:
+    """Per-file memo shared by every flow-aware rule."""
+    if "callgraph" not in ctx.cache:
+        ctx.cache["callgraph"] = ModuleGraph(ctx.tree)
+    return ctx.cache["callgraph"]

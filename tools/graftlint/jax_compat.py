@@ -1,0 +1,126 @@
+"""Declarative table of moved/removed JAX symbols, pinned to versions.
+
+The repo floats across JAX versions (driver boxes run 0.4.x, chips run
+newer), and JAX relocates public API with a deprecation window that ends
+in an AttributeError — exactly what took out the pipeline_moe /
+ring_attention suites (``jax.shard_map`` only exists top-level from
+0.6). The JAX-COMPAT rule (rules/compat.py) walks source for these
+dotted paths and fires ONLY when the predicate here says the installed
+version does not ship the symbol; the finding message carries the
+rewrite target, so fixing is mechanical.
+
+An entry is present in ``[added, removed)``:
+
+- ``added``: first version shipping the symbol (None = always has).
+- ``removed``: first version where it is gone (None = still shipped).
+
+String access (``getattr(jax, "shard_map", None)``, ``hasattr``) never
+matches — that IS the compat idiom ray_tpu/utils/jax_compat.py uses, and
+the lint must point at it, not chase it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+
+@dataclasses.dataclass(frozen=True)
+class MovedSymbol:
+    dotted: str               # the path exactly as written in source
+    replacement: str          # what the --fix rewrite would insert
+    added: str | None = None
+    removed: str | None = None
+    note: str = ""
+
+
+TABLE: tuple[MovedSymbol, ...] = (
+    MovedSymbol(
+        "jax.shard_map",
+        replacement="ray_tpu.utils.jax_compat.shard_map",
+        added="0.6.0",
+        note="top-level alias only ships from jax 0.6; the shim falls "
+             "back to jax.experimental.shard_map.shard_map and maps "
+             "check_vma/axis_names onto check_rep/auto"),
+    MovedSymbol(
+        "jax.tree_map",
+        replacement="jax.tree.map",
+        removed="0.6.0",
+        note="deprecated since 0.4.25, removed in 0.6; "
+             "ray_tpu.utils.jax_compat.tree_map spans both"),
+    MovedSymbol(
+        "jax.tree_multimap",
+        replacement="jax.tree.map",
+        removed="0.3.16"),
+    MovedSymbol(
+        "jax.tree_leaves",
+        replacement="jax.tree.leaves",
+        removed="0.6.0"),
+    MovedSymbol(
+        "jax.tree_unflatten",
+        replacement="jax.tree.unflatten",
+        removed="0.6.0"),
+    MovedSymbol(
+        "jax.experimental.maps.xmap",
+        replacement="ray_tpu.utils.jax_compat.shard_map",
+        removed="0.4.31",
+        note="xmap was deleted outright; shard_map is the designated "
+             "successor"),
+    MovedSymbol(
+        "jax.experimental.pjit.with_sharding_constraint",
+        replacement="jax.lax.with_sharding_constraint",
+        removed="0.4.7"),
+    MovedSymbol(
+        "jax.linear_util",
+        replacement="jax.extend.linear_util",
+        removed="0.4.24"),
+    MovedSymbol(
+        "jax.random.KeyArray",
+        replacement="jax.Array",
+        removed="0.4.24"),
+    MovedSymbol(
+        "jax.abstract_arrays",
+        replacement="jax.core.ShapedArray (jax.abstract_arrays was "
+                    "folded into jax.core)",
+        removed="0.4.12"),
+)
+
+BY_DOTTED: dict[str, MovedSymbol] = {s.dotted: s for s in TABLE}
+
+
+def parse_version(v: str) -> tuple[int, ...]:
+    """Lenient numeric-prefix parse: '0.4.37', '0.6.0.dev20+g1f2' → ints.
+    Anything unparseable compares as 0 so a weird local build fails open
+    (no findings) rather than spraying false positives."""
+    out: list[int] = []
+    for part in v.split(".")[:3]:
+        m = re.match(r"\d+", part)
+        if not m:
+            break
+        out.append(int(m.group()))
+    while len(out) < 3:
+        out.append(0)
+    return tuple(out)
+
+
+def absent_in(sym: MovedSymbol, version: str) -> bool:
+    """True when `version` does NOT ship `sym` — the rule's firing
+    predicate."""
+    v = parse_version(version)
+    if sym.added is not None and v < parse_version(sym.added):
+        return True
+    if sym.removed is not None and v >= parse_version(sym.removed):
+        return True
+    return False
+
+
+def installed_jax_version() -> str:
+    """The version the lint run judges against. Import stays lazy and
+    failure-open: no jax on the lint box → '0.0.0.unknown', which makes
+    every `removed=` entry read as present (no findings) while `added=`
+    entries still fire — by far the safer default for a lint gate."""
+    try:
+        import jax
+        return jax.__version__
+    except (ImportError, AttributeError):
+        return "0.0.0"

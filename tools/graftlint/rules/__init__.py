@@ -1,9 +1,12 @@
 """Rule registry. Each rule targets a failure mode this codebase has
 actually hit (see ISSUE/PR history): silent constant-folds, per-step
 re-lowers, blocked event loops, swallowed control-plane failures,
-unpicklable `.remote()` captures."""
+unpicklable `.remote()` captures — and, from v2, cache-key drift into
+jitted call sites, mesh/PartitionSpec mismatches, and references to JAX
+APIs the installed version doesn't ship."""
 
 from tools.graftlint.rules.asyncio_rules import AsyncBlockRule
+from tools.graftlint.rules.compat import JaxCompatRule
 from tools.graftlint.rules.exceptions import ExcSwallowRule
 from tools.graftlint.rules.jit import (
     DonateMissRule,
@@ -12,7 +15,9 @@ from tools.graftlint.rules.jit import (
     JitInLoopRule,
     JitSideEffectRule,
 )
+from tools.graftlint.rules.recompile import RecompileHazardRule
 from tools.graftlint.rules.serialize import SerCaptureRule
+from tools.graftlint.rules.shardspec import ShardSpecRule
 
 ALL_RULES = [
     JitClosureRule(),
@@ -23,6 +28,13 @@ ALL_RULES = [
     HostSyncInHotLoopRule(),
     ExcSwallowRule(),
     SerCaptureRule(),
+    RecompileHazardRule(),
+    ShardSpecRule(),
+    JaxCompatRule(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
+
+# v2 rule families — kept here so CI and the baseline tests can name the
+# set without enumerating it twice.
+V2_FAMILIES = ("RECOMPILE-HAZARD", "SHARD-SPEC", "JAX-COMPAT")

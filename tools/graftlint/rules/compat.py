@@ -1,0 +1,85 @@
+"""JAX-COMPAT: source references a JAX symbol the installed version
+does not ship (moved or removed API).
+
+The symbol table with version ranges lives in tools/graftlint/
+jax_compat.py; this rule is only the AST matcher. It fires on
+
+- dotted attribute chains: ``jax.shard_map(...)``, ``jax.tree_map(f, t)``
+- from-imports: ``from jax import shard_map``,
+  ``from jax.experimental.maps import xmap``
+- plain imports of a moved module: ``import jax.linear_util``
+
+and stays quiet on string-based access (``getattr(jax, "shard_map",
+None)``) because that is the sanctioned compat idiom.
+
+The installed-version predicate is overridable (``GRAFTLINT_JAX_VERSION``
+env var or the constructor) so CI can pin the judgment version and tests
+can exercise both sides of a range without installing two JAXes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.graftlint import jax_compat as table
+from tools.graftlint.engine import FileContext, Finding, Rule
+from tools.graftlint.rules._shared import dotted
+
+
+class JaxCompatRule(Rule):
+    id = "JAX-COMPAT"
+    summary = ("reference to a JAX API the installed version does not "
+               "ship (moved/removed symbol; message carries the rewrite)")
+
+    def __init__(self, version: str | None = None):
+        self._version = version
+
+    @property
+    def version(self) -> str:
+        return (self._version
+                or os.environ.get("GRAFTLINT_JAX_VERSION")
+                or table.installed_jax_version())
+
+    def _firing(self) -> dict[str, table.MovedSymbol]:
+        v = self.version
+        return {s.dotted: s for s in table.TABLE if table.absent_in(s, v)}
+
+    def _finding(self, ctx: FileContext, node: ast.AST,
+                 sym: table.MovedSymbol, spelled: str) -> Finding:
+        gone = (f"absent before jax {sym.added}" if sym.added
+                else f"removed in jax {sym.removed}")
+        msg = (f"`{spelled}` is {gone} (installed: {self.version}) — "
+               f"fix: use `{sym.replacement}`")
+        if sym.note:
+            msg += f" [{sym.note}]"
+        return ctx.finding(self.id, node, msg)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        firing = self._firing()
+        if not firing:
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                d = dotted(node)
+                if d in firing:
+                    out.append(self._finding(ctx, node, firing[d], d))
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    d = f"{node.module}.{a.name}"
+                    if d in firing:
+                        out.append(self._finding(
+                            ctx, node, firing[d],
+                            f"from {node.module} import {a.name}"))
+                if node.module in firing:
+                    out.append(self._finding(
+                        ctx, node, firing[node.module],
+                        f"from {node.module} import ..."))
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in firing:
+                        out.append(self._finding(
+                            ctx, node, firing[a.name],
+                            f"import {a.name}"))
+        return out

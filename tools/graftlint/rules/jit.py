@@ -6,6 +6,12 @@ JIT-IN-LOOP         jax.jit(...) constructed (or .astype re-lowered) per
                     loop iteration
 DONATE-MISS         train-step-shaped jit without donate_argnums
 HOST-SYNC-IN-HOT-LOOP  device→host sync inside a decode/step loop
+
+v2: JIT-CLOSURE and HOST-SYNC-IN-HOT-LOOP resolve ONE level of local
+helper calls through the module call graph (callgraph.py) — a traced fn
+whose *helper* reads the array global, or a hot loop whose *helper* does
+the `.item()`, no longer hides the hazard behind the call. Exactly one
+hop; two-hop chains are out of scope by design.
 """
 
 from __future__ import annotations
@@ -13,6 +19,7 @@ from __future__ import annotations
 import ast
 import re
 
+from tools.graftlint.callgraph import module_graph
 from tools.graftlint.engine import FileContext, Finding, Rule
 from tools.graftlint.rules._shared import (
     LOG_METHODS,
@@ -100,6 +107,47 @@ class JitClosureRule(Rule):
                             f"`self.{node.attr}`: bound-method jit captures "
                             "self — the array constant-folds; pass it as an "
                             "argument"))
+
+        # One-hop: the traced fn calls a local helper whose body reads an
+        # array global. The helper isn't itself jitted (the direct scan
+        # owns that case) and isn't a def nested in the traced fn (the
+        # direct walk above already descends into those).
+        graph = module_graph(ctx)
+        jitted_ids = {id(jf.node) for jf in collect_jitted_cached(ctx)}
+        if array_globals:
+            for jf in collect_jitted_cached(ctx):
+                bound = bound_names(jf.node)
+                body = jf.node.body if isinstance(jf.node.body, list) \
+                    else [jf.node.body]
+                reported: set[tuple[int, str]] = set()
+                for stmt in body:
+                    for node in ast.walk(stmt):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        for helper in graph.resolve_call(node):
+                            if id(helper) in jitted_ids \
+                                    or helper.name in bound:
+                                continue
+                            h_bound = bound_names(helper)
+                            for sub in ast.walk(helper):
+                                if isinstance(sub, ast.Name) \
+                                        and isinstance(sub.ctx, ast.Load) \
+                                        and sub.id in array_globals \
+                                        and sub.id not in h_bound:
+                                    key = (id(node), sub.id)
+                                    if key in reported:
+                                        continue
+                                    reported.add(key)
+                                    out.append(ctx.finding(
+                                        self.id, node,
+                                        f"`{jf.name}` is traced and calls "
+                                        f"`{helper.name}`, which reads "
+                                        f"module-level array `{sub.id}` "
+                                        "from its closure (one call-hop "
+                                        "inside the jit boundary): the "
+                                        "value constant-folds at trace "
+                                        "time — thread it through as an "
+                                        "argument"))
         return out
 
 
@@ -242,19 +290,44 @@ class HostSyncInHotLoopRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
+        graph = module_graph(ctx)
+
+        def helper_sync(call: ast.Call,
+                        enclosing: list) -> tuple[str, str] | None:
+            """One-hop: (helper name, sync spelling) when the callee is a
+            local def whose body does a host sync directly. A callee that
+            resolves to a function we are currently *inside* is skipped —
+            that's recursion (or a same-named method on another object),
+            and the direct scan already owns this body."""
+            for helper in graph.resolve_call(call):
+                if any(helper is e for e in enclosing):
+                    continue
+                for sub in ast.walk(helper):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    f = sub.func
+                    if isinstance(f, ast.Attribute) and f.attr in (
+                            "item", "block_until_ready"):
+                        return helper.name, f".{f.attr}()"
+                    if dotted(f) in _HOST_SYNC_DOTTED:
+                        return helper.name, f"{dotted(f)}(...)"
+            return None
 
         class V(ast.NodeVisitor):
             def __init__(self):
                 self.hot_fn: list[str] = []
+                self.fn_stack: list[ast.AST] = []
                 self.loop_depth = 0
 
             def _fn(self, node):
                 hot = bool(_HOT_NAME.search(node.name))
                 if hot:
                     self.hot_fn.append(node.name)
+                self.fn_stack.append(node)
                 saved, self.loop_depth = self.loop_depth, 0
                 self.generic_visit(node)
                 self.loop_depth = saved
+                self.fn_stack.pop()
                 if hot:
                     self.hot_fn.pop()
 
@@ -283,6 +356,14 @@ class HostSyncInHotLoopRule(Rule):
                                "host per iteration — batch the transfer "
                                "outside the loop or amortize over a "
                                "multi-step window")
+                    else:
+                        hop = helper_sync(node, self.fn_stack)
+                        if hop:
+                            msg = (f"the `{self.hot_fn[-1]}` loop calls "
+                                   f"`{hop[0]}`, which does {hop[1]} — a "
+                                   "device sync per iteration, one call-"
+                                   "hop away; batch the transfer outside "
+                                   "the loop")
                     if msg:
                         out.append(ctx.finding(
                             HostSyncInHotLoopRule.id, node, msg))

@@ -1,0 +1,193 @@
+"""RECOMPILE-HAZARD: call sites that feed a jit-wrapped callable a
+cache-key-varying value — the static half of the flight recorder's
+runtime recompile-storm alarm (ray_tpu/compile_watch.py).
+
+jit's executable cache is keyed on (static-arg VALUES, traced-arg
+SHAPES/dtypes, kwarg NAMES in call order). Three spellings make that key
+vary per call without anything looking wrong locally:
+
+1. a value derived from ``len(...)``/``.shape``/an enclosing loop
+   variable passed at a ``static_argnums``/``static_argnames`` position
+   — every distinct value compiles a fresh executable;
+2. an argument whose SHAPE varies per iteration (a slice with a
+   ``len()``/``.shape``-derived bound, or an array factory sized that
+   way) fed to a jitted call inside a loop;
+3. ``f(**kwargs)`` splat into a jitted call — the cache key includes
+   keyword names in dict order, so two call sites building the dict
+   differently re-trace despite identical values;
+
+plus the interprocedural one the v1 JIT-IN-LOOP rule can't see:
+
+4. a loop calling a local helper that constructs a ``jax.jit`` inside
+   its own body — a fresh compilation cache per iteration, one call-hop
+   away (one hop exactly; two-hop chains are out of scope, see
+   callgraph.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.callgraph import module_graph
+from tools.graftlint.engine import FileContext, Finding, Rule
+from tools.graftlint.rules._shared import dotted
+
+# Expressions whose value changes call-to-call on any real data path.
+_VARYING_DOTTED = {"time.time", "time.monotonic", "time.perf_counter",
+                   "time.time_ns", "random.random", "random.randint"}
+_SHAPEY_ATTRS = {"shape", "size", "ndim"}
+
+
+def _varies(expr: ast.AST, loop_vars: set[str]) -> str | None:
+    """Why `expr` is cache-key-varying, or None if we can't tell. Only
+    clearly-varying derivations count (len/.shape/loop var/wall clock) —
+    a bare parameter name might be constant across calls, so it stays
+    quiet."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "len":
+                return "a len(...)-derived value"
+            if dotted(f) in _VARYING_DOTTED:
+                return f"a {dotted(f)}() value"
+        elif isinstance(node, ast.Attribute) and node.attr in _SHAPEY_ATTRS:
+            return f"a .{node.attr}-derived value"
+        elif isinstance(node, ast.Name) and node.id in loop_vars:
+            return f"the loop variable `{node.id}`"
+    return None
+
+
+def _shape_varies(expr: ast.AST, loop_vars: set[str]) -> str | None:
+    """Why `expr`'s SHAPE varies per call: a slice with a varying bound,
+    or an array factory sized by one."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Subscript) and isinstance(node.slice,
+                                                          ast.Slice):
+            for bound in (node.slice.lower, node.slice.upper):
+                if bound is None or isinstance(bound, ast.Constant):
+                    continue
+                why = _varies(bound, loop_vars)
+                if why:
+                    return f"a slice bounded by {why}"
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            if d.split(".")[-1] in ("zeros", "ones", "full", "empty",
+                                    "arange") and node.args:
+                why = _varies(node.args[0], loop_vars)
+                if why:
+                    return f"an array factory sized by {why}"
+    return None
+
+
+class RecompileHazardRule(Rule):
+    id = "RECOMPILE-HAZARD"
+    summary = ("call site feeds a jit-wrapped callable a cache-key-"
+               "varying value (static-arg drift, per-iteration shapes, "
+               "kwargs splat, or a jit-constructing helper in a loop)")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        graph = module_graph(ctx)
+        rule_id = self.id
+
+        def loop_target_names(node: ast.For | ast.AsyncFor) -> set[str]:
+            return {n.id for n in ast.walk(node.target)
+                    if isinstance(n, ast.Name)}
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.loop_depth = 0
+                self.loop_vars: set[str] = set()
+
+            def _for(self, node):
+                added = loop_target_names(node)
+                saved = set(self.loop_vars)
+                self.loop_vars |= added
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+                self.loop_vars = saved
+
+            visit_For = _for
+            visit_AsyncFor = _for
+
+            def visit_While(self, node):
+                self.loop_depth += 1
+                self.generic_visit(node)
+                self.loop_depth -= 1
+
+            def visit_Call(self, node):
+                self._check_jitted_call(node)
+                self._check_helper_in_loop(node)
+                self.generic_visit(node)
+
+            def _check_jitted_call(self, node: ast.Call):
+                bindings = graph.jit_bindings_for_call(node)
+                if not bindings:
+                    return
+                # (3) kwargs splat — fires wherever it appears.
+                if any(kw.arg is None for kw in node.keywords):
+                    out.append(ctx.finding(
+                        rule_id, node,
+                        f"`{bindings[0].name}(**kwargs)`: the jit cache "
+                        "key includes keyword names in dict order — two "
+                        "sites building the dict differently re-trace on "
+                        "identical values; pass arguments explicitly"))
+                for b in bindings:
+                    # (1) varying value at a static position.
+                    for pos in b.static_argnums:
+                        if pos < len(node.args):
+                            why = _varies(node.args[pos], self.loop_vars)
+                            if why:
+                                out.append(ctx.finding(
+                                    rule_id, node.args[pos],
+                                    f"`{b.name}` marks argument {pos} "
+                                    f"static, but this call passes {why}: "
+                                    "every distinct value compiles a "
+                                    "fresh executable — keep it traced "
+                                    "or hoist it to a constant"))
+                    for kw in node.keywords:
+                        if kw.arg and kw.arg in b.static_argnames:
+                            why = _varies(kw.value, self.loop_vars)
+                            if why:
+                                out.append(ctx.finding(
+                                    rule_id, kw.value,
+                                    f"`{b.name}` marks `{kw.arg}` static, "
+                                    f"but this call passes {why}: every "
+                                    "distinct value compiles a fresh "
+                                    "executable — keep it traced or "
+                                    "hoist it to a constant"))
+                # (2) per-iteration shape drift into a jitted call.
+                if self.loop_depth > 0:
+                    for arg in node.args:
+                        why = _shape_varies(arg, self.loop_vars)
+                        if why:
+                            out.append(ctx.finding(
+                                rule_id, arg,
+                                f"jitted `{bindings[0].name}` called in a "
+                                f"loop with {why}: the argument shape is "
+                                "part of the cache key, so every new "
+                                "length re-lowers — pad to a bucket or "
+                                "hoist the varying dimension"))
+
+            def _check_helper_in_loop(self, node: ast.Call):
+                # (4) helper that constructs a jit, called inside a loop.
+                if self.loop_depth == 0:
+                    return
+                if graph.jit_bindings_for_call(node):
+                    return            # direct jitted call, not a helper
+                for helper in graph.resolve_call(node):
+                    site = graph.constructs_jit(helper)
+                    if site is not None:
+                        out.append(ctx.finding(
+                            rule_id, node,
+                            f"`{helper.name}` constructs a jit wrapper "
+                            f"(line {site.lineno}) and is called inside "
+                            "a loop: a fresh compilation cache per "
+                            "iteration, one call-hop from the loop — "
+                            "hoist the wrapper out of the helper or the "
+                            "helper out of the loop"))
+                        break
+
+        V().visit(ctx.tree)
+        return out

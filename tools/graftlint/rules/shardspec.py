@@ -1,0 +1,252 @@
+"""SHARD-SPEC: mesh/PartitionSpec consistency, statically.
+
+The tensor-parallel roadmap item introduces exactly one class of bug at
+review time: a PartitionSpec naming an axis the mesh doesn't have (XLA
+errors at trace time — on the chip, hours later), a shard_map whose
+in/out spec arity silently misaligns with the mapped function, an axis
+used twice in one spec, and a donated buffer read after the call that
+consumed it. All four are lexical properties.
+
+Checks (one rule id, four spellings):
+
+- UNKNOWN AXIS: a string axis in ``PartitionSpec(...)`` that is not in
+  the union of axis names declared by any ``Mesh``/``make_mesh`` in the
+  same file. Files that declare no mesh are skipped — the mesh may come
+  in as a parameter and the axis vocabulary is unknowable lexically.
+- ARITY: ``shard_map(f, in_specs=(...))`` where ``f`` is a lambda or a
+  local def and the spec tuple length differs from ``f``'s positional
+  arity (a non-tuple in_specs is a pytree prefix broadcast — skipped).
+- DUPLICATE AXIS: one mesh axis appearing twice in a single spec
+  (``P('dp', 'dp')`` or ``P(('dp', 'x'), 'dp')``) — an axis can shard
+  at most one dimension.
+- DONATE ALIAS: an argument at a ``donate_argnums`` position of a
+  jit-wrapped callable whose variable is read again later in the same
+  function with no intervening rebind — the donated buffer is dead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.callgraph import module_graph
+from tools.graftlint.engine import FileContext, Finding, Rule
+from tools.graftlint.rules._shared import dotted
+
+_MESH_CALLEES = {"Mesh", "make_mesh", "AbstractMesh"}
+_SPEC_BASENAMES = {"PartitionSpec"}
+
+
+def _axis_strings(node: ast.AST) -> list[str]:
+    """String axis names in one spec argument (str or tuple/list of str)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+        return out
+    return []
+
+
+def _spec_aliases(tree: ast.AST) -> set[str]:
+    """Names PartitionSpec is imported as (P, PS, PartitionSpec, ...)."""
+    names = set(_SPEC_BASENAMES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in _SPEC_BASENAMES:
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _mesh_axes_in_call(call: ast.Call) -> list[str]:
+    """Axis names a Mesh/make_mesh construction declares, [] if opaque."""
+    callee = call.func.attr if isinstance(call.func, ast.Attribute) \
+        else (call.func.id if isinstance(call.func, ast.Name) else None)
+    if callee == "MeshConfig":
+        # The repo's own mesh constructor (parallel/mesh.py): axes are
+        # declared as keyword sizes — MeshConfig(dp=2, pp=2, ...).
+        return [kw.arg for kw in call.keywords if kw.arg]
+    if callee not in _MESH_CALLEES:
+        return []
+    cand = None
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            cand = kw.value
+    if cand is None and len(call.args) >= 2:
+        cand = call.args[1]
+    return _axis_strings(cand) if cand is not None else []
+
+
+def _positional_arity(fn: ast.FunctionDef | ast.Lambda) -> int:
+    a = fn.args
+    return len(a.posonlyargs) + len(a.args)
+
+
+class ShardSpecRule(Rule):
+    id = "SHARD-SPEC"
+    summary = ("PartitionSpec axis missing from every lexical mesh, "
+               "shard_map spec arity != mapped fn arity, duplicate axis "
+               "in one spec, or a donated buffer read after the call")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        graph = module_graph(ctx)
+        spec_names = _spec_aliases(ctx.tree)
+
+        # -------- mesh axis vocabulary (file-wide union: conservative —
+        # any declared mesh legitimizes its axes everywhere in the file).
+        mesh_axes: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                mesh_axes.update(_mesh_axes_in_call(node))
+
+        def spec_call(node: ast.Call) -> bool:
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            return name in spec_names
+
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and spec_call(node)):
+                continue
+            axes: list[str] = []
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                axes.extend(_axis_strings(arg))
+            # duplicate axis within one spec
+            seen: set[str] = set()
+            for ax in axes:
+                if ax in seen:
+                    out.append(ctx.finding(
+                        self.id, node,
+                        f"axis `{ax}` appears twice in one PartitionSpec "
+                        "— a mesh axis can shard at most one dimension "
+                        "of one array"))
+                seen.add(ax)
+            # unknown axis vs. the file's declared meshes
+            if mesh_axes:
+                for ax in axes:
+                    if ax not in mesh_axes:
+                        out.append(ctx.finding(
+                            self.id, node,
+                            f"PartitionSpec names axis `{ax}` but every "
+                            "mesh declared in this file has axes "
+                            f"{sorted(mesh_axes)} — an unknown axis "
+                            "fails at trace time on the chip"))
+
+        # -------- shard_map arity
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            callee = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if callee != "shard_map":
+                continue
+            mapped = node.args[0] if node.args else None
+            in_specs = None
+            for kw in node.keywords:
+                if kw.arg == "in_specs":
+                    in_specs = kw.value
+            if in_specs is None and len(node.args) >= 3:
+                in_specs = node.args[2]
+            if mapped is None or not isinstance(in_specs, (ast.Tuple,
+                                                           ast.List)):
+                continue                 # pytree-prefix broadcast: fine
+            fn = None
+            if isinstance(mapped, ast.Lambda):
+                fn = mapped
+            elif isinstance(mapped, ast.Name):
+                cands = graph.defs.get(mapped.id, [])
+                fn = cands[0] if cands else None
+            if fn is None:
+                continue
+            arity = _positional_arity(fn)
+            n_specs = len(in_specs.elts)
+            if arity != n_specs:
+                out.append(ctx.finding(
+                    self.id, node,
+                    f"shard_map in_specs carries {n_specs} spec(s) but "
+                    f"the mapped function takes {arity} positional "
+                    "argument(s) — the mismatch surfaces as a confusing "
+                    "tree-structure error at trace time"))
+
+        # -------- donated buffer read after the call
+        out.extend(self._donate_alias(ctx, graph))
+        return out
+
+    def _donate_alias(self, ctx: FileContext, graph) -> list[Finding]:
+        out: list[Finding] = []
+
+        def sym(node: ast.AST) -> str | None:
+            """`x` or a dotted self.x chain as a stable key."""
+            if isinstance(node, ast.Name):
+                return node.id
+            return dotted(node)
+
+        for fn in [n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            calls = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for b in graph.jit_bindings_for_call(node):
+                    if b.donate_argnums:
+                        calls.append((node, b))
+                        break
+            if not calls:
+                continue
+            loads: dict[str, list[int]] = {}
+            stores: dict[str, list[int]] = {}
+            for node in ast.walk(fn):
+                s = None
+                if isinstance(node, ast.Name):
+                    s = node.id
+                elif isinstance(node, ast.Attribute):
+                    s = dotted(node)
+                if s is None:
+                    continue
+                tgt = loads if isinstance(getattr(node, "ctx", None),
+                                          ast.Load) else stores
+                tgt.setdefault(s, []).append(node.lineno)
+            # Line arithmetic is over the *enclosing statement's* span,
+            # not the call's first line: a donated call regularly spans
+            # lines (`(a, b) = f(\n  a, b)`) and both its own argument
+            # loads and its assignment-target stores must not read as
+            # "after the call".
+            stmts = [n for n in ast.walk(fn) if isinstance(n, ast.stmt)]
+            for call, b in calls:
+                call_end = getattr(call, "end_lineno", call.lineno)
+                enclosing = [s for s in stmts
+                             if s.lineno <= call.lineno
+                             and getattr(s, "end_lineno",
+                                         s.lineno) >= call_end]
+                stmt = min(enclosing, default=None,
+                           key=lambda s: getattr(s, "end_lineno",
+                                                 s.lineno) - s.lineno)
+                start = stmt.lineno if stmt is not None else call.lineno
+                end = getattr(stmt, "end_lineno", call_end) \
+                    if stmt is not None else call_end
+                for pos in b.donate_argnums:
+                    if pos >= len(call.args):
+                        continue
+                    s = sym(call.args[pos])
+                    if s is None:
+                        continue
+                    later_loads = [ln for ln in loads.get(s, [])
+                                   if ln > end]
+                    if not later_loads:
+                        continue
+                    first = min(later_loads)
+                    rebound = any(start <= ln <= first
+                                  for ln in stores.get(s, []))
+                    if not rebound:
+                        out.append(ctx.finding(
+                            self.id, call,
+                            f"`{s}` is donated to `{b.name}` (argnums "
+                            f"{pos}) but read again on line {first}: "
+                            "donation hands XLA the buffer — the later "
+                            "read sees freed memory (jax errors at best)"))
+        return out

@@ -151,7 +151,9 @@ def main() -> None:
     if os.path.exists(SUMMARY):
         try:
             prev = json.load(open(SUMMARY))
-        except Exception:
+        except (OSError, ValueError):
+            # Unreadable/corrupt summary: start fresh rather than abort
+            # a multi-hour curve run over a truncated file.
             prev = []
     done = {r["algo"] for r in out}
     out = [r for r in prev if r["algo"] not in done] + out
