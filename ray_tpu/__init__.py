@@ -22,7 +22,7 @@ def __getattr__(name):
 
         return getattr(api, name)
     if name in ("GetTimeoutError", "TaskCancelledError", "ActorDiedError",
-                "RayActorError"):
+                "ActorUnavailableError", "RayActorError"):
         from ray_tpu import exceptions
 
         return getattr(exceptions, name)

@@ -48,6 +48,21 @@ class TaskCancelledError(RayTaskError):
     (ref: exceptions.py TaskCancelledError)."""
 
 
+class ActorDiedError(RayTaskError):
+    """The actor running the task is dead (ref: exceptions.py
+    RayActorError). Subclasses RayTaskError so existing broad catches
+    keep working, but is distinguishable for failover: Serve's
+    controller reaps the replica immediately instead of waiting out the
+    health-probe strike window, and the proxies retry the request
+    against a surviving replica."""
+
+
+class ActorUnavailableError(RayTaskError):
+    """The actor could not be reached but is not known dead (still
+    starting / restarting / retry budget exhausted). Retriable-elsewhere
+    like ActorDiedError, but NOT a definitive death verdict."""
+
+
 class ObjectRef:
     """Future-like handle to an object in the cluster.
 

@@ -49,8 +49,16 @@ class _PlacementRetry(Exception):
     """Placement attempt failed but the actor remains RESTARTING."""
 
 
-class ActorDiedError(RuntimeError):
-    pass
+def __getattr__(name):
+    # Back-compat import path: the canonical ActorDiedError moved to
+    # api.py (it subclasses RayTaskError so typed actor-death results
+    # from to_exception() stay catchable by broad RayTaskError handlers).
+    # Lazy to avoid an api<->client import cycle at module init.
+    if name == "ActorDiedError":
+        from ray_tpu.api import ActorDiedError
+
+        return ActorDiedError
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class _PendingTask:

@@ -232,6 +232,25 @@ class Config:
     # cold start before failing the request.
     serve_cold_start_timeout_s: float = 60.0
 
+    # --- serve fault tolerance (drain / failover) ---
+    # How long a replica shed by scale-down or a version roll may spend
+    # finishing its in-flight work before the controller hard-kills it.
+    # The replica's drain() stops admission, lets live decodes finish,
+    # and exports whatever remains as resumable continuations; <= 0
+    # restores the legacy hard-kill behavior.
+    serve_drain_timeout_s: float = 30.0
+    # Failover retries per request at the proxies/handles: on a replica
+    # death or drain rejection the request is resubmitted to a re-picked
+    # replica (streams resume from their cursor with already-emitted
+    # tokens teacher-forced) this many times before the client sees an
+    # error.
+    serve_failover_attempts: int = 3
+    # Controller checkpoint write: bounded retries with exponential
+    # backoff so one transient GCS blip doesn't silently cost the next
+    # controller restart its state.
+    serve_ckpt_write_retries: int = 4
+    serve_ckpt_write_backoff_s: float = 0.2
+
     # --- LLM serving engine ---
     # Fused decode window: tokens generated per device dispatch with
     # on-device sampling. The dominant knob when dispatch latency is

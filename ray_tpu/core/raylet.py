@@ -390,9 +390,37 @@ class Raylet:
                 logger.warning("worker %s disconnected", WorkerID(wid).hex()[:8])
                 self._return_resources(h)
                 self.workers.pop(wid, None)
+                if h.actor_id is not None:
+                    # Death notification (ref: node_manager worker-failure
+                    # report → gcs_actor_manager.cc OnWorkerDead): the
+                    # raylet is the FIRST to see an actor worker die — the
+                    # GCS must transition the actor NOW (RESTARTING, or
+                    # DEAD broadcast to every subscribed client) instead
+                    # of the owner discovering the corpse one dial-timeout
+                    # ladder later. Without this, an actor that dies with
+                    # no call in flight keeps its stale ALIVE address in
+                    # the GCS and new dispatches hang for minutes before
+                    # anyone drives the restart; with it, clients get the
+                    # pubsub verdict in milliseconds — ActorDiedError for
+                    # non-restartable actors (Serve failover keys off
+                    # this), a driven restart for restartable ones.
+                    spawn(self._report_actor_death(h.actor_id))
                 # Freed resources may satisfy queued lease requests; without a
                 # pump they would sit until lease_timeout_s.
                 self._pump_leases()
+
+    async def _report_actor_death(self, actor_id: bytes) -> None:
+        try:
+            await self.gcs.call("actor_failed", {
+                "actor_id": actor_id,
+                "error": "actor worker process died",
+                "transition_only": True,
+            })
+        except Exception as e:
+            # The owner-side dial-failure ladder is the (slow) fallback
+            # detector; losing this report only costs latency.
+            logger.warning("actor death report for %s failed: %s",
+                           actor_id.hex()[:8], e)
 
     def _return_resources(self, h: WorkerHandle) -> None:
         bundle = (self.pg_bundles.get(h.bundle_key)

@@ -21,10 +21,22 @@ class TaskError:
         self.cause = cause
 
     def to_exception(self) -> Exception:
-        from ray_tpu.api import RayTaskError, TaskCancelledError
+        from ray_tpu.api import (
+            ActorDiedError,
+            ActorUnavailableError,
+            RayTaskError,
+            TaskCancelledError,
+        )
 
-        cls = (TaskCancelledError if self.exc_type == "TaskCancelledError"
-               else RayTaskError)
+        # Actor-death results surface as the TYPED exception (all are
+        # RayTaskError subclasses, so broad catches keep working): Serve's
+        # controller and proxies key failover decisions off the class, not
+        # off string-matching the message.
+        cls = {
+            "TaskCancelledError": TaskCancelledError,
+            "ActorDiedError": ActorDiedError,
+            "ActorUnavailableError": ActorUnavailableError,
+        }.get(self.exc_type, RayTaskError)
         return cls(self.exc_type, self.message, self.tb)
 
     def __repr__(self):

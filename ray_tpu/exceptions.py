@@ -6,8 +6,13 @@ failures. Here the canonical classes live where they are raised (api.py,
 core/client.py) — this module is the stable public import path.
 """
 
-from ray_tpu.api import RayTaskError, TaskCancelledError
-from ray_tpu.core.client import ActorDiedError, GetTimeoutError
+from ray_tpu.api import (
+    ActorDiedError,
+    ActorUnavailableError,
+    RayTaskError,
+    TaskCancelledError,
+)
+from ray_tpu.core.client import GetTimeoutError
 
 # The reference's RayActorError == "actor died while executing the task".
 RayActorError = ActorDiedError
@@ -17,5 +22,6 @@ __all__ = [
     "TaskCancelledError",
     "GetTimeoutError",
     "ActorDiedError",
+    "ActorUnavailableError",
     "RayActorError",
 ]

@@ -197,6 +197,20 @@ class DeploymentHandle:
             if not client.actor_state(r._actor_id.binary()).dead
         ]
 
+    def evict_replica(self, replica) -> None:
+        """Failover hint: drop a replica from the cached route table NOW
+        (a caller just observed it die or reject work while draining).
+        The pubsub death notification / controller routing bump carry the
+        same fact, but may lag the very next pick — without this an
+        immediate no-backoff retry can land on the same corpse and burn
+        the whole failover budget. Purely local: a still-routable replica
+        reappears on the next table refresh."""
+        aid = replica._actor_id.binary()
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r._actor_id.binary() != aid]
+            self._local_inflight.pop(aid, None)
+
     def _pick_replica(self):
         replicas: list = []
         for attempt in range(4):
@@ -323,38 +337,88 @@ class DeploymentHandle:
                deadline_s: float = 600.0):
         """Incremental results from a streaming deployment (e.g. the LLM
         engine's per-token stream): yields items as the replica produces
-        them instead of buffering the full response. The whole stream is
-        pinned to ONE replica — the cursor state lives there. Protocol:
+        them instead of buffering the full response. Protocol:
         `submit_method(request) -> stream_id`, then
         `poll_method(stream_id, cursor, timeout) ->
         {"tokens": [...], "done": bool, ...}` long-polled until done.
-        """
+
+        The stream pins to ONE replica (cursor state lives there) until
+        that replica dies or drains; then the already-yielded tokens are
+        resubmitted teacher-forced (`generated_ids`) to a re-picked
+        replica and the stream resumes at the same cursor — callers see
+        an uninterrupted item sequence (cursor-exact splice, same
+        contract as the async proxy's SSE failover)."""
         import ray_tpu
+        from ray_tpu.core.config import runtime_config
 
-        replica = self._pick_replica()
-
-        def _call(method, *call_args):
-            # Tracked like method() dispatches: long token streams must
-            # weigh on the local p2c signal, not look like an idle replica.
-            return self.dispatch(replica, method, call_args, {})
-
-        sid = ray_tpu.get(_call(submit_method, request), timeout=deadline_s)
+        attempts = max(0, runtime_config().serve_failover_attempts)
 
         def gen():
             import time as _time
 
-            cursor = 0
+            from ray_tpu.serve.http_proxy import _FAILOVERS, failover_mode
+
+            emitted: list = []
+            budget = attempts
             t_end = _time.monotonic() + deadline_s
+            replica = None
+            sid = None
+
+            def _call(replica, method, *call_args):
+                # Tracked like method() dispatches: long token streams
+                # must weigh on the local p2c signal.
+                return self.dispatch(replica, method, call_args, {})
+
+            def _resume(mode: str, victim) -> bool:
+                # Mirrors HTTPProxy._stream_sse._failover — the protocol
+                # invariants live in that docstring; keep both in sync.
+                nonlocal budget, sid
+                if budget <= 0:
+                    return False
+                budget -= 1
+                if victim is not None:
+                    self.evict_replica(victim)
+                _FAILOVERS.inc(1.0, tags={
+                    "route": self.deployment_name,
+                    "mode": f"stream_{mode}"})
+                sid = None
+                return True
+
             while True:
-                out = ray_tpu.get(
-                    _call(poll_method, sid, cursor, poll_timeout_s),
-                    timeout=60)
+                try:
+                    if sid is None:
+                        replica = self._pick_replica()
+                        req = dict(request)
+                        if emitted:
+                            req["generated_ids"] = list(emitted)
+                        sid = ray_tpu.get(
+                            _call(replica, submit_method, req),
+                            timeout=deadline_s)
+                        cursor = len(emitted)
+                    out = ray_tpu.get(
+                        _call(replica, poll_method, sid, cursor,
+                              poll_timeout_s),
+                        timeout=60)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    mode = failover_mode(e)
+                    if mode is not None and _resume(mode, replica):
+                        continue
+                    raise
                 for tok in out["tokens"]:
                     yield tok
+                emitted.extend(out["tokens"])
                 cursor += len(out["tokens"])
-                if out.get("error"):
-                    raise RuntimeError(out["error"])
+                err = out.get("error")
+                if err:
+                    if "unknown stream" in err and _resume("death", replica):
+                        continue
+                    raise RuntimeError(err)
                 if out.get("done"):
+                    if out.get("migrated"):
+                        if _resume("drain", replica):
+                            continue
+                        raise RuntimeError(
+                            "replica drained; failover budget exhausted")
                     return
                 if _time.monotonic() > t_end:
                     raise TimeoutError(f"stream {sid} exceeded deadline")

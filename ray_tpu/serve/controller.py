@@ -17,11 +17,34 @@ import threading
 import time
 from typing import Any
 
+from ray_tpu import chaos as _chaos
+from ray_tpu import profiling as _profiling
+
 logger = logging.getLogger(__name__)
 
 ROUTES_CHANNEL = "serve_routes"
 CKPT_NS = "serve"
 CKPT_KEY = b"controller_ckpt"
+
+# Drain protocol observability: one count per drained replica by outcome
+# (clean = in-flight work finished inside the window; exported =
+# continuations handed back for cross-replica resume; timeout = the
+# window expired without an answer → hard kill; dead = the replica died
+# mid-drain), plus the wall time each drain took and how many
+# continuations left.
+_DRAIN_TOTAL = _profiling.Counter(
+    "serve_drain_total",
+    description="Serve replicas drained, by outcome",
+    tag_keys=("deployment", "outcome"))
+_DRAIN_EXPORTED = _profiling.Counter(
+    "serve_drain_exported_total",
+    description="Resumable continuations exported by draining replicas",
+    tag_keys=("deployment",))
+_DRAIN_DURATION = _profiling.Histogram(
+    "serve_drain_duration_s",
+    description="Wall time from drain request to replica reap",
+    boundaries=_profiling.LATENCY_BUCKETS_S,
+    tag_keys=("deployment",))
 
 # Record fields persisted across controller restarts. Runtime bookkeeping
 # (over/under_since) deliberately excluded — autoscaler timers restart clean.
@@ -96,6 +119,10 @@ class ServeController:
             d["under_since"] = None
             d["cold_ts"] = None
             d["replica_load"] = {}
+            # Runtime-only: replicas draining at crash time are orphans
+            # for the restarted controller — their membership loop sees
+            # is_member()=False, self-drains, and exits.
+            d["draining"] = []
             import time as _time
 
             _now = _time.monotonic()
@@ -138,17 +165,36 @@ class ServeController:
         def _write():
             from ray_tpu import api as _api
 
-            try:
-                with self._ckpt_write_lock:     # one writer in flight
-                    with self._lock:
-                        if seq != self._ckpt_seq:
-                            return  # a newer snapshot supersedes this one
-                    _api._ensure_client().kv_put(
-                        CKPT_NS, CKPT_KEY, bytes(blob))
-            except Exception as e:
-                # A lost snapshot means the NEXT controller restart loses
-                # state — the failure must not wait until then to surface.
-                logger.warning("controller checkpoint write failed: %s", e)
+            # Bounded retry with backoff: one transient GCS blip must not
+            # silently cost the NEXT controller restart its state. The
+            # lock is released between attempts (a newer snapshot may be
+            # racing) and the seq guard re-checks before every write so a
+            # superseded snapshot aborts instead of clobbering.
+            retries = max(0, int(getattr(
+                self._cfg, "serve_ckpt_write_retries", 4)))
+            backoff = getattr(self._cfg, "serve_ckpt_write_backoff_s", 0.2)
+            last: Exception | None = None
+            for attempt in range(retries + 1):
+                try:
+                    with self._ckpt_write_lock:     # one writer in flight
+                        with self._lock:
+                            if seq != self._ckpt_seq:
+                                return  # a newer snapshot supersedes this
+                        _chaos.hit("serve.controller.ckpt_write")
+                        _api._ensure_client().kv_put(
+                            CKPT_NS, CKPT_KEY, bytes(blob))
+                    return
+                except Exception as e:
+                    last = e
+                    logger.debug("controller checkpoint write attempt "
+                                 "%d/%d failed: %s", attempt + 1,
+                                 retries + 1, e)
+                if attempt < retries:      # no dead sleep after the last try
+                    time.sleep(backoff * (2 ** attempt))
+            # Every attempt failed — the failure must not wait until the
+            # next restart to surface.
+            logger.warning("controller checkpoint write failed after %d "
+                           "attempts: %s", retries + 1, last)
 
         threading.Thread(target=_write, daemon=True).start()
 
@@ -180,7 +226,7 @@ class ServeController:
             ac = None
         with self._lock:
             old = self.deployments.get(name)
-            if old is not None and (
+            same_cfg = old is not None and (
                 old["cls_blob"] == cls_blob
                 and old["init_args"] == init_args
                 and old["init_kwargs"] == init_kwargs
@@ -190,42 +236,61 @@ class ServeController:
                 and old["user_config"] == user_config
                 and old.get("autoscaling_spec") == autoscaling_config
                 and (ac is None) == (old.get("autoscaling") is None)
-                and (ac is not None or old["num_replicas"] == num_replicas)
-            ):
+            )
+            if same_cfg and (ac is not None
+                             or old["num_replicas"] == num_replicas):
                 # Idempotent redeploy (graph re-runs, shared diamond
                 # children): nothing changed — don't roll healthy replicas.
                 return True
-            self.deployments[name] = {
-                "name": name,
-                "cls_blob": cls_blob,
-                "init_args": init_args,
-                "init_kwargs": init_kwargs,
-                "num_replicas": num_replicas,
-                "route_prefix": route_prefix,
-                "resources": resources,
-                "max_concurrent_queries": max_concurrent_queries,
-                "user_config": user_config,
-                "autoscaling": ac,
-                "autoscaling_spec": autoscaling_config,
-                # autoscaler bookkeeping: when the load first crossed the
-                # scale-up/-down threshold (None = not currently crossed)
-                "over_since": None,
-                "under_since": None,
-                "cold_ts": None,
-                # actor_id → last stats-probe payload (runtime-only; the
-                # per-replica load surface behind get_load()/status()).
-                "replica_load": {},
-                "replicas": old["replicas"] if old else [],
-                # Spawned but not yet past their first health probe —
-                # NOT in the routing table (ref: deployment_state.py
-                # STARTING → RUNNING; routing a still-booting replica
-                # makes requests wait out the whole actor boot).
-                "starting": old.get("starting", []) if old else [],
-                "generation": (old["generation"] + 1) if old else 0,
-            }
-            if old:
-                # config/code changed → roll all replicas
-                self._drain_replicas(self.deployments[name], all=True)
+            if same_cfg:
+                # Only the replica count changed: resize IN PLACE — the
+                # reconcile loop sheds excess replicas through the drain
+                # protocol (or spawns missing ones). Rolling every
+                # healthy replica for a scale-down would churn exactly
+                # the capacity a scale-down is trying to conserve.
+                old["num_replicas"] = num_replicas
+                old["over_since"] = None
+                old["under_since"] = None
+                resized = True
+            else:
+                resized = False
+            if not resized:
+                self.deployments[name] = {
+                    "name": name,
+                    "cls_blob": cls_blob,
+                    "init_args": init_args,
+                    "init_kwargs": init_kwargs,
+                    "num_replicas": num_replicas,
+                    "route_prefix": route_prefix,
+                    "resources": resources,
+                    "max_concurrent_queries": max_concurrent_queries,
+                    "user_config": user_config,
+                    "autoscaling": ac,
+                    "autoscaling_spec": autoscaling_config,
+                    # autoscaler bookkeeping: when the load first crossed
+                    # the scale-up/-down threshold (None = not crossed)
+                    "over_since": None,
+                    "under_since": None,
+                    "cold_ts": None,
+                    # actor_id → last stats-probe payload (runtime-only;
+                    # the load surface behind get_load()/status()).
+                    "replica_load": {},
+                    "replicas": old["replicas"] if old else [],
+                    # Spawned but not yet past their first health probe —
+                    # NOT in the routing table (ref: deployment_state.py
+                    # STARTING → RUNNING; routing a still-booting replica
+                    # makes requests wait out the whole actor boot).
+                    "starting": old.get("starting", []) if old else [],
+                    # Replicas already mid-drain ride into the new record
+                    # so the reaper keeps tracking them across the roll.
+                    "draining": list(old.get("draining", [])) if old else [],
+                    "generation": (old["generation"] + 1) if old else 0,
+                }
+                if old:
+                    # config/code changed → roll all replicas: the old
+                    # generation drains (in-flight work finishes or
+                    # migrates) while the new generation boots.
+                    self._drain_replicas(self.deployments[name], all=True)
             self._bump_version_locked()
             self._checkpoint_locked()
         self._reconcile_once(only=name)
@@ -235,7 +300,12 @@ class ServeController:
         with self._lock:
             d = self.deployments.pop(name, None)
             if d:
-                self._drain_replicas(d, all=True)
+                # Explicit teardown: the deployment record is gone, so
+                # nothing would reap an async drain — hard-kill, and
+                # finish off anything already mid-drain.
+                self._drain_replicas(d, all=True, hard=True)
+                for ent in d.get("draining", []):
+                    self._kill_replica(ent["h"])
             self._bump_version_locked()
             self._checkpoint_locked()
         return True
@@ -286,7 +356,12 @@ class ServeController:
                 return False
             return (any(aid == actor_id_hex for aid, _h in d["replicas"])
                     or any(aid == actor_id_hex
-                           for aid, _h, _t in d.get("starting", [])))
+                           for aid, _h, _t in d.get("starting", []))
+                    # Draining replicas stay members until reaped: the
+                    # orphan self-exit must not race the drain window
+                    # (stream readers are still draining their cursors).
+                    or any(ent["aid"] == actor_id_hex
+                           for ent in d.get("draining", [])))
 
     def list_deployments(self) -> dict:
         with self._lock:
@@ -295,6 +370,7 @@ class ServeController:
                     "num_replicas": d["num_replicas"],
                     "live_replicas": len(d["replicas"]),
                     "starting_replicas": len(d.get("starting", [])),
+                    "draining_replicas": len(d.get("draining", [])),
                     "route_prefix": d["route_prefix"],
                     "autoscaling": d.get("autoscaling"),
                     # Last stats probe per routable replica (short id →
@@ -333,10 +409,23 @@ class ServeController:
         self._stop = True
         with self._lock:
             for d in self.deployments.values():
-                self._drain_replicas(d, all=True)
+                # Teardown, not scale-down: the controller is about to be
+                # killed itself, so no reaper would outlive an async
+                # drain — hard-kill (and reap anything mid-drain too).
+                self._drain_replicas(d, all=True, hard=True)
+                for ent in d.get("draining", []):
+                    self._kill_replica(ent["h"])
+                d["draining"] = []
             self.deployments.clear()
             self._bump_version_locked()
             self._checkpoint_locked()
+        return True
+
+    def install_chaos(self, rules) -> bool:
+        """Arm a chaos spec in the controller process (fault-injection
+        tests: kill-mid-reconcile, checkpoint write failure — see
+        ray_tpu/chaos.py)."""
+        _chaos.install(rules)
         return True
 
     # ------------------------------------------------------------ reconcile
@@ -360,19 +449,131 @@ class ServeController:
 
         threading.Thread(target=_publish, daemon=True).start()
 
-    def _drain_replicas(self, d: dict, all: bool = False, keep: int = 0):
+    @staticmethod
+    def _kill_replica(handle) -> None:
         import ray_tpu
 
+        try:
+            ray_tpu.kill(handle)
+        except Exception:  # graftlint: disable=EXC-SWALLOW (kill target may already be dead)
+            pass
+
+    def _drain_replicas(self, d: dict, all: bool = False, keep: int = 0,
+                        hard: bool = False):
+        """Shed serving replicas through the drain protocol: victims
+        leave the routing table NOW (no new work routes to them), get a
+        drain() RPC that finishes or exports their in-flight work, and
+        are hard-killed only when the RPC answers or
+        `serve_drain_timeout_s` expires (_reap_draining). `hard=True`
+        (teardown paths / timeout<=0) restores the immediate kill.
+        Booting replicas are always killed immediately — they hold no
+        client work."""
         victims = list(d["replicas"] if all else d["replicas"][keep:])
-        if all:
-            victims += [(a, h) for (a, h, _t) in d.get("starting", [])]
-            d["starting"] = []
-        for _aid, handle in victims:
-            try:
-                ray_tpu.kill(handle)
-            except Exception:  # graftlint: disable=EXC-SWALLOW (drain target may already be dead)
-                pass
         d["replicas"] = [] if all else d["replicas"][:keep]
+        if all:
+            for _aid, h, _t in d.get("starting", []):
+                self._kill_replica(h)
+            d["starting"] = []
+        if not victims:
+            return
+        timeout = getattr(self._cfg, "serve_drain_timeout_s", 30.0)
+        if hard or timeout <= 0:
+            for _aid, handle in victims:
+                self._kill_replica(handle)
+            return
+        now = time.monotonic()
+        for aid, handle in victims:
+            try:
+                ref = handle.drain.remote(timeout)
+            except Exception as e:
+                # Submit failure is not a verdict — the reaper's
+                # death-check/deadline still bounds the replica's life.
+                logger.warning("drain submit to %s failed: %s", aid[-8:], e)
+                ref = None
+            d.setdefault("draining", []).append({
+                "aid": aid, "h": handle, "ref": ref,
+                "t0": now, "deadline": now + timeout,
+            })
+
+    def _reap_draining(self, only: str | None = None) -> None:
+        """Finish the drain protocol: kill each draining replica once its
+        drain() RPC answered, its deadline passed, or it died. Runs
+        OUTSIDE the lock (kill/wait are RPCs); entries are removed under
+        the lock by identity, so concurrent appends are never lost."""
+        import ray_tpu
+        from ray_tpu import api as _api
+
+        with self._lock:
+            # Claim entries under the lock: reconciles overlap (the
+            # background loop plus deploy/delete-scoped ones), and two
+            # passes reaping the same entry would double-kill and
+            # double-count the drain metrics.
+            snap = []
+            for name, d in self.deployments.items():
+                if only is not None and name != only:
+                    continue
+                for ent in d.get("draining", []):
+                    if not ent.get("claimed"):
+                        ent["claimed"] = True
+                        snap.append((name, ent))
+        if not snap:
+            return
+        client = _api._ensure_client()
+        reaped: list[tuple[str, dict, str, dict | None]] = []
+        for name, ent in snap:
+            outcome = None
+            res = None
+            ref = ent.get("ref")
+            if ref is not None:
+                try:
+                    ready, _p = ray_tpu.wait([ref], num_returns=1, timeout=0)
+                except Exception:  # graftlint: disable=EXC-SWALLOW (probe failure falls through to the death/deadline checks)
+                    ready = []
+                if ready:
+                    try:
+                        res = ray_tpu.get(ref, timeout=5)
+                        outcome = ("exported" if res.get("exported")
+                                   else "clean")
+                    except Exception:  # graftlint: disable=EXC-SWALLOW (replica died mid-drain; outcome recorded as dead)
+                        outcome = "dead"
+            if outcome is None:
+                try:
+                    dead = client.actor_state(
+                        ent["h"]._actor_id.binary()).dead
+                except Exception:  # graftlint: disable=EXC-SWALLOW (state probe failure: the deadline below still bounds the drain)
+                    dead = False
+                if dead:
+                    outcome = "dead"
+                elif time.monotonic() >= ent["deadline"]:
+                    outcome = "timeout"
+            if outcome is None:
+                continue
+            self._kill_replica(ent["h"])
+            reaped.append((name, ent, outcome, res))
+        with self._lock:
+            reaped_set = {id(ent) for _n, ent, _o, _r in reaped}
+            for name, ent in snap:
+                if id(ent) not in reaped_set:
+                    ent["claimed"] = False    # not done yet: next pass
+            for name, ent, _o, _r in reaped:
+                d = self.deployments.get(name)
+                if d is not None:
+                    d["draining"] = [e for e in d.get("draining", [])
+                                     if e is not ent]
+        if not reaped:
+            return
+        for name, ent, outcome, res in reaped:
+            dur = time.monotonic() - ent["t0"]
+            _DRAIN_TOTAL.inc(1.0, tags={"deployment": name,
+                                        "outcome": outcome})
+            _DRAIN_DURATION.observe(dur, tags={"deployment": name})
+            exported = int((res or {}).get("exported", 0))
+            if exported:
+                _DRAIN_EXPORTED.inc(float(exported),
+                                    tags={"deployment": name})
+            logger.info("drained replica %s of %s: outcome=%s "
+                        "exported=%d in %.2fs", ent["aid"][-8:], name,
+                        outcome, exported, dur)
 
     def _loop(self):
         interval = getattr(self._cfg, "serve_reconcile_interval_s", 0.5)
@@ -452,6 +653,12 @@ class ServeController:
         import ray_tpu
         from ray_tpu.serve.replica import Replica
 
+        # Chaos fault point: a "kill" rule here dies mid-reconcile — the
+        # scenario the checkpoint/adopt restart contract must survive.
+        _chaos.hit("serve.controller.reconcile")
+        # Finish any in-flight drains first: a drained replica's kill
+        # must not wait behind this tick's probe round.
+        self._reap_draining(only)
         with self._lock:
             snapshot = [
                 (name, d["generation"], list(d["replicas"]),

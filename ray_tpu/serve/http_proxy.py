@@ -54,6 +54,43 @@ _QUEUE_WAIT = profiling.Histogram(
     "serve_queue_wait_s",
     description="Ingress queue wait: request admission to replica dispatch",
     boundaries=profiling.LATENCY_BUCKETS_S, tag_keys=("route",))
+# Fault-tolerance accounting (shared by both proxy implementations and
+# DeploymentHandle.stream): every failover — a request resubmitted to a
+# surviving replica after a death/drain — and every request that reached
+# a client as an error, by reason.
+_FAILOVERS = profiling.Counter(
+    "serve_failovers_total",
+    description="Requests failed over to a surviving replica",
+    tag_keys=("route", "mode"))
+_REQS_FAILED = profiling.Counter(
+    "serve_requests_failed_total",
+    description="Ingress requests that returned an error to the client",
+    tag_keys=("route", "reason"))
+
+
+# Drain/migration rejections cross the actor boundary as RayTaskError
+# text, so classification matches these exact marker phrases (the ones
+# Replica.handle_request / LLMEngine.submit / LLMDeployment.generate
+# raise with) — NOT loose substrings, which would silently re-run a user
+# exception that merely mentions "draining" on another replica.
+_DRAIN_MARKERS = ("replica draining:",
+                  "request migrated off draining replica")
+
+
+def failover_mode(e: BaseException) -> str | None:
+    """Classify an exception as retriable-on-another-replica.
+
+    → "death" (replica actor died / unreachable), "drain" (replica
+    rejected or migrated the request while draining), or None (not a
+    failover case — surface to the client)."""
+    from ray_tpu.exceptions import ActorDiedError, ActorUnavailableError
+
+    if isinstance(e, (ActorDiedError, ActorUnavailableError)):
+        return "death"
+    s = str(e)
+    if any(m in s for m in _DRAIN_MARKERS):
+        return "drain"
+    return None
 
 
 def _decode_payload(command: str, parsed, headers: dict, body: bytes):
@@ -156,6 +193,7 @@ class HTTPProxy(_RouterMixin):
         self._timeout = (request_timeout_s if request_timeout_s is not None
                          else cfg.serve_http_request_timeout_s)
         self._max_body = cfg.serve_http_max_body_bytes
+        self._failover_attempts = max(0, cfg.serve_failover_attempts)
         self._idle_timeout = cfg.serve_http_idle_timeout_s
         self._max_conns = cfg.serve_http_max_connections
         self._conns = 0
@@ -317,9 +355,11 @@ class HTTPProxy(_RouterMixin):
         # the LLM engine tag their metrics by the ingress route.
         ctx.baggage.setdefault("route", name or parsed.path)
         status = 500
+        reason = "error"
         try:
             if name is None:
                 status = 404
+                reason = "no_route"
                 await self._send(writer, 404, b'{"error": "no route"}',
                                  extra=trace_headers)
                 return False
@@ -329,6 +369,7 @@ class HTTPProxy(_RouterMixin):
                 # Admission control: surface overload instead of queueing
                 # unboundedly (ref: http_proxy request backpressure).
                 status = 503
+                reason = "overloaded"
                 await self._send(writer, 503, b'{"error": "overloaded"}',
                                  extra=((b"Retry-After", b"1"),)
                                  + trace_headers)
@@ -340,8 +381,7 @@ class HTTPProxy(_RouterMixin):
                     status = 200
                     return await self._stream_sse(
                         name, handle, payload, writer, trace_headers)
-                ref = await self._submit(name, handle, payload)
-                result = await self._await_ref(ref)
+                result = await self._call_unary(name, handle, payload)
                 status = 200
                 await self._send(
                     writer, 200, json.dumps({"result": result}).encode(),
@@ -349,9 +389,15 @@ class HTTPProxy(_RouterMixin):
                 return False
             except (ConnectionResetError, BrokenPipeError):
                 status = 499
+                reason = "client_disconnect"
                 return True
             except Exception as e:  # noqa: BLE001
                 status = 500
+                from ray_tpu.core.client import GetTimeoutError
+
+                reason = ("timeout" if isinstance(e, GetTimeoutError)
+                          else ("replica_death"
+                                if failover_mode(e) == "death" else "error"))
                 try:
                     await self._send(
                         writer, 500, json.dumps({"error": str(e)}).encode(),
@@ -365,6 +411,9 @@ class HTTPProxy(_RouterMixin):
             tracing.reset_current(token)
             dur = time.time() - t_start
             _REQS_TOTAL.inc(1.0, tags={"route": route, "status": str(status)})
+            if status >= 400:
+                _REQS_FAILED.inc(1.0, tags={"route": route,
+                                            "reason": reason})
             _REQ_LATENCY.observe(dur, tags={"route": route})
             profiling.record_event(
                 f"HTTP {command} {parsed.path}", "serve", t_start, dur,
@@ -397,9 +446,29 @@ class HTTPProxy(_RouterMixin):
         _QUEUE_WAIT.observe(time.time() - t0, tags={"route": name})
         return replica
 
-    async def _submit(self, name: str, handle, payload):
-        replica = await self._pick(name, handle)
-        return handle.dispatch(replica, "__call__", (payload,), {})
+    async def _call_unary(self, name: str, handle, payload):
+        """One request → one replica, with bounded failover: a replica
+        death (ActorDiedError out of the dispatch/await) or drain
+        rejection retries immediately against a re-picked replica before
+        the client sees any error. The unary path delivers nothing until
+        completion, so a full re-run is side-effect-safe."""
+        for attempt in range(self._failover_attempts + 1):
+            replica = await self._pick(name, handle)
+            try:
+                ref = handle.dispatch(replica, "__call__", (payload,), {})
+                return await self._await_ref(ref)
+            except Exception as e:  # noqa: BLE001 — classified below
+                mode = failover_mode(e)
+                if mode is None or attempt >= self._failover_attempts:
+                    raise
+                # Drop the dead/draining replica from the route cache NOW
+                # — the pubsub death notification / routing bump may lag
+                # one pick, and a no-backoff retry that lands on the same
+                # replica just burns the failover budget.
+                handle.evict_replica(replica)
+                _FAILOVERS.inc(1.0, tags={"route": name,
+                                          "mode": f"unary_{mode}"})
+        raise RuntimeError("unreachable")  # loop always returns or raises
 
     async def _await_ref(self, ref):
         """Thread-free wait on a result ref; falls back to a pool thread for
@@ -421,50 +490,120 @@ class HTTPProxy(_RouterMixin):
     async def _stream_sse(self, name, handle, payload, writer,
                           trace_headers: tuple = ()) -> bool:
         """Server-sent events: tokens flush as the replica produces them.
-        The stream is pinned to one replica (cursor state lives there);
-        every poll wait is thread-free. Body is EOF-terminated
-        (Connection: close), so no chunked framing is needed."""
+        Every poll wait is thread-free. Body is EOF-terminated
+        (Connection: close), so no chunked framing is needed.
+
+        The stream is pinned to one replica (cursor state lives there) —
+        until that replica dies or drains. The proxy's emitted-token list
+        IS the continuation record: on ActorDiedError (or a drain
+        migration/rejection) the request is resubmitted to a surviving
+        replica with the already-emitted tokens teacher-forced
+        (`generated_ids`), the replica seeds its stream with them, and
+        the proxy resumes reading at cursor = len(emitted) — so the
+        client-visible stream splices cursor-exactly: no token is ever
+        re-streamed or skipped, and the failover is invisible apart from
+        one inter-token gap."""
         payload = {k: v for k, v in payload.items() if k != "stream"}
-        replica = await self._pick(name, handle)
+        emitted: list = []       # tokens already sent to the client
+        attempts_left = self._failover_attempts
+        headers_sent = False
+        replica = None
+        sid = None
 
-        def _call(method, *args):
-            return handle.dispatch(replica, method, args, {})
+        async def _failover(mode: str, victim) -> bool:
+            nonlocal attempts_left, sid
+            if attempts_left <= 0:
+                return False
+            attempts_left -= 1
+            if victim is not None:
+                # Dead OR draining: either way this replica must not be
+                # re-picked by the immediate retry below.
+                handle.evict_replica(victim)
+            _FAILOVERS.inc(1.0, tags={"route": name,
+                                      "mode": f"stream_{mode}"})
+            sid = None           # re-pick + resubmit on the next loop turn
+            return True
 
-        sid = await self._await_ref(_call("submit_stream", payload))
-        head = (b"HTTP/1.1 200 OK\r\n"
-                b"Content-Type: text/event-stream\r\n"
-                b"Cache-Control: no-cache\r\n"
-                b"Connection: close\r\n")
-        for k, v in trace_headers:
-            head += k + b": " + v + b"\r\n"
-        writer.write(head + b"\r\n")
-        await writer.drain()
         try:
-            cursor = 0
             while True:
-                out = await self._await_ref(
-                    _call("stream_read", sid, cursor, 0.25))
+                try:
+                    if sid is None:
+                        replica = await self._pick(name, handle)
+                        req = dict(payload)
+                        if emitted:
+                            req["generated_ids"] = list(emitted)
+                        sid = await self._await_ref(handle.dispatch(
+                            replica, "submit_stream", (req,), {}))
+                        cursor = len(emitted)
+                    out = await self._await_ref(handle.dispatch(
+                        replica, "stream_read", (sid, cursor, 0.25), {}))
+                except Exception as e:  # noqa: BLE001 — classified below
+                    mode = failover_mode(e)
+                    if mode is not None and await _failover(mode, replica):
+                        continue
+                    raise
+                if not headers_sent:
+                    # Headers only after a successful submit: a total
+                    # failure before any byte left still gets a clean 500
+                    # from _respond instead of a truncated SSE body.
+                    head = (b"HTTP/1.1 200 OK\r\n"
+                            b"Content-Type: text/event-stream\r\n"
+                            b"Cache-Control: no-cache\r\n"
+                            b"Connection: close\r\n")
+                    for k, v in trace_headers:
+                        head += k + b": " + v + b"\r\n"
+                    writer.write(head + b"\r\n")
+                    headers_sent = True
                 for tok in out["tokens"]:
                     writer.write(
                         b"data: " + json.dumps({"token": tok}).encode()
                         + b"\n\n")
                 if out["tokens"]:
                     await writer.drain()
-                cursor += len(out["tokens"])
-                if out.get("error"):
+                    emitted.extend(out["tokens"])
+                    cursor += len(out["tokens"])
+                err = out.get("error")
+                if err:
+                    # A stream record lost before completion (replica
+                    # restarted between polls, drain raced the submit) is
+                    # still resumable from the proxy's emitted record.
+                    if ("unknown stream" in err
+                            and await _failover("death", replica)):
+                        continue
+                    # Streamed failures bypass the HTTP status (headers
+                    # already said 200) — count them here or the failed-
+                    # requests counter is blind to every SSE error.
+                    _REQS_FAILED.inc(1.0, tags={"route": name,
+                                                "reason": "stream_error"})
                     writer.write(
-                        b"data: " + json.dumps(
-                            {"error": out["error"]}).encode() + b"\n\n")
+                        b"data: " + json.dumps({"error": err}).encode()
+                        + b"\n\n")
                     break
                 if out.get("done"):
+                    if out.get("migrated"):
+                        # Drain export: this replica's leg ended with the
+                        # request unfinished — resume elsewhere.
+                        if await _failover("drain", replica):
+                            continue
+                        _REQS_FAILED.inc(1.0, tags={
+                            "route": name,
+                            "reason": "failover_exhausted"})
+                        writer.write(b"data: " + json.dumps(
+                            {"error": "replica drained; failover budget "
+                                      "exhausted"}).encode() + b"\n\n")
+                        break
                     writer.write(b"data: [DONE]\n\n")
                     break
             await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             pass  # client went away mid-stream
-        except Exception as e:  # noqa: BLE001 — headers already sent:
+        except Exception as e:  # noqa: BLE001 — if headers are out,
             # surface the failure as an SSE error event, never as HTTP
             # bytes injected into the open stream.
+            if not headers_sent:
+                raise  # _respond turns this into a clean HTTP 500
+            _REQS_FAILED.inc(1.0, tags={"route": name,
+                                        "reason": "stream_error"})
             try:
                 writer.write(b"data: " + json.dumps(
                     {"error": str(e)}).encode() + b"\n\n")
@@ -520,6 +659,8 @@ class ThreadedHTTPProxy(_RouterMixin):
                 raw = self.rfile.read(length) if length else b""
                 name = proxy._match(parsed.path)
                 if name is None:
+                    _REQS_FAILED.inc(1.0, tags={"route": "__unmatched__",
+                                                "reason": "no_route"})
                     self._json_reply(404, b'{"error": "no route"}')
                     return
                 payload, wants_stream = _decode_payload(
@@ -528,14 +669,42 @@ class ThreadedHTTPProxy(_RouterMixin):
                 try:
                     handle = proxy._handle(name)
                     import ray_tpu
+                    from ray_tpu.core.config import runtime_config
 
                     if wants_stream and isinstance(payload, dict):
+                        # handle.stream resumes across replica death /
+                        # drain internally (cursor-exact splice).
                         self._stream_sse(handle, payload)
                         return
-                    result = ray_tpu.get(handle.remote(payload), timeout=120)
+                    # Unary failover: a replica death or drain rejection
+                    # retries against a re-picked replica before any 500.
+                    # Sync mirror of HTTPProxy._call_unary (the async
+                    # proxy owns the canonical semantics — keep in sync).
+                    attempts = max(
+                        0, runtime_config().serve_failover_attempts)
+                    for attempt in range(attempts + 1):
+                        replica = handle._pick_replica()
+                        try:
+                            result = ray_tpu.get(
+                                handle.dispatch(
+                                    replica, "__call__", (payload,), {}),
+                                timeout=120)
+                            break
+                        except Exception as e:  # noqa: BLE001
+                            mode = failover_mode(e)
+                            if mode is None or attempt >= attempts:
+                                raise
+                            handle.evict_replica(replica)
+                            _FAILOVERS.inc(1.0, tags={
+                                "route": name, "mode": f"unary_{mode}"})
                     self._json_reply(
                         200, json.dumps({"result": result}).encode())
                 except Exception as e:
+                    _REQS_FAILED.inc(1.0, tags={
+                        "route": name,
+                        "reason": ("replica_death"
+                                   if failover_mode(e) == "death"
+                                   else "error")})
                     self._json_reply(
                         500, json.dumps({"error": str(e)}).encode())
 
@@ -557,6 +726,9 @@ class ThreadedHTTPProxy(_RouterMixin):
                 except (BrokenPipeError, ConnectionResetError):
                     pass  # client went away mid-stream
                 except Exception as e:
+                    _REQS_FAILED.inc(1.0, tags={
+                        "route": handle.deployment_name,
+                        "reason": "stream_error"})
                     try:
                         self.wfile.write(
                             b"data: " + json.dumps(

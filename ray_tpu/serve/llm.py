@@ -44,6 +44,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ray_tpu import chaos as _chaos
 from ray_tpu import profiling as _profiling
 from ray_tpu import tracing
 
@@ -150,6 +151,10 @@ class GenRequest:
     temperature: float
     eos_id: int | None
     submitted_at: float
+    # Original prompt length: prompt_ids grows past it on preemption
+    # (recompute context = prompt + generated), so continuation export
+    # needs the split point to avoid double-counting generated tokens.
+    n_prompt: int = 0
     first_token_at: float | None = None
     finished_at: float | None = None
     # TTFT breakdown (engine-side wall clock): first/last prefill dispatch
@@ -163,6 +168,15 @@ class GenRequest:
     admit_bypasses: int = 0
     out_ids: list[int] = dataclasses.field(default_factory=list)
     truncated: bool = False   # finished early (capacity/unresumable preempt)
+    # Exported off a draining/dying engine as a resumable continuation:
+    # done is set, error is None, and the consumer (proxy / handle
+    # stream) resubmits (prompt, out_ids) to a surviving replica.
+    migrated: bool = False
+    # Last stream_read touch (perf_counter): drain's read-out wait only
+    # holds for streams someone is actually consuming — an abandoned
+    # record (client vanished mid-stream) must not cost a scale-down the
+    # full drain window.
+    last_read_at: float | None = None
     stream: "queue.Queue | None" = None
     done: "threading.Event" = dataclasses.field(
         default_factory=threading.Event)
@@ -372,6 +386,15 @@ class LLMEngine:
         self._window_seq = 0                  # decode windows dispatched
         self._shutdown = threading.Event()
         self._fatal: str | None = None
+        # Drain protocol (replica scale-down / version roll): draining
+        # engines reject new submits, finish in-flight work, and export
+        # whatever the drain window didn't cover as resumable
+        # continuations (see drain()).
+        self._draining = False
+        # Tick fence for drain(): a request popped from `pending` during
+        # admission is invisible to slot/queue checks until it binds a
+        # slot — the quiescence verdict is only stable between ticks.
+        self._mid_tick = False
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
         self.stats = {"requests": 0, "tokens_generated": 0,
@@ -390,45 +413,96 @@ class LLMEngine:
 
     def submit(self, prompt_ids: list[int], *, max_tokens: int = 64,
                temperature: float = 0.0, eos_id: int | None = None,
-               stream: bool = False) -> GenRequest:
+               stream: bool = False,
+               generated_ids: list[int] | None = None,
+               request_id: str | None = None) -> GenRequest:
+        """Queue one generation request.
+
+        `generated_ids` resumes a continuation migrated off another
+        replica (drain export / death failover): the already-emitted
+        tokens are teacher-forced — they join the prefill context, seed
+        out_ids (so max_tokens stays a TOTAL output budget and the
+        stream cursor splices exactly), and are never re-emitted. Same
+        math as the in-replica preempt-by-recompute path, so a greedy
+        continuation is byte-identical to the uninterrupted run.
+        """
         # An empty prompt has no last-token logits to sample from: the
         # one-shot path would emit an arbitrary token, the chunked path
         # would never build a chunk row and wedge its slot forever.
         if not prompt_ids:
             raise ValueError("prompt_ids must be non-empty")
-        # One-shot mode caps at the largest prefill bucket; chunked mode
-        # only at the cache (max_len needs headroom for ≥1 token).
-        if len(prompt_ids) > self._prompt_cap:
-            raise ValueError(
-                f"prompt too long: {len(prompt_ids)} (cap "
-                f"{self._prompt_cap}: "
-                + ("cache bound, chunked prefill" if self.prefill_chunk
-                   else f"bucket cap {self.buckets[-1]}, cache cap "
-                        f"{self.max_len - 1}") + ")")
-        if (self.kv_mode == "paged"
-                and self._pages_for(len(prompt_ids)) > self.n_pages):
-            # A prompt the pool can never cover would requeue forever.
-            raise ValueError(
-                f"prompt needs {self._pages_for(len(prompt_ids))} KV pages "
-                f"but the pool only has {self.n_pages}")
+        generated = [int(t) for t in (generated_ids or [])]
+        context = list(prompt_ids) + generated
+        too_big = (len(context) > self._prompt_cap
+                   or (self.kv_mode == "paged"
+                       and self._pages_for(len(context)) > self.n_pages))
         req = GenRequest(
-            request_id=uuid.uuid4().hex[:12],
-            prompt_ids=list(prompt_ids),
+            request_id=request_id or uuid.uuid4().hex[:12],
+            prompt_ids=context,
+            n_prompt=len(prompt_ids),
             max_tokens=max_tokens,
             temperature=temperature,
             eos_id=eos_id,
             submitted_at=time.perf_counter(),
+            out_ids=generated,
             stream=queue.Queue() if stream else None,
         )
-        # The fatal check and the enqueue must be atomic with the death
-        # handler's one-shot pending drain, or a submit racing the dying
-        # engine could enqueue after the drain and hang forever.
+        if generated and (
+                len(generated) >= max_tokens
+                or (eos_id is not None and generated[-1] == eos_id)):
+            # The continuation is already complete — the source replica
+            # died/drained between emitting the final token and the
+            # reader observing done. Finish it here instead of rejecting
+            # (the consumer needs [DONE], not an error) or decoding past
+            # eos (extra tokens the uninterrupted run never produced).
+            self._finish_presubmit(req, truncated=False)
+            return req
+        if too_big:
+            if generated:
+                # Mid-stream resume that no longer fits this engine's
+                # caps: finish with what the client already has, flagged
+                # truncated — the same contract as an in-replica preempt
+                # whose regrown context stopped fitting (_preempt). An
+                # error here would drop a live stream over a capacity
+                # detail the client can't act on.
+                self._finish_presubmit(req, truncated=True)
+                return req
+            if len(context) > self._prompt_cap:
+                raise ValueError(
+                    f"prompt too long: {len(context)} (cap "
+                    f"{self._prompt_cap}: "
+                    + ("cache bound, chunked prefill" if self.prefill_chunk
+                       else f"bucket cap {self.buckets[-1]}, cache cap "
+                            f"{self.max_len - 1}") + ")")
+            # A prompt the pool can never cover would requeue forever.
+            raise ValueError(
+                f"prompt needs {self._pages_for(len(context))} KV pages "
+                f"but the pool only has {self.n_pages}")
+        # The fatal/draining check and the enqueue must be atomic with the
+        # death handler's / drain export's one-shot pending drain, or a
+        # submit racing them could enqueue after the drain and hang.
         with self._lock:
             if self._fatal is not None:
                 raise RuntimeError(self._fatal)
+            if self._draining:
+                raise RuntimeError(
+                    "replica draining: not accepting new requests")
             self.stats["requests"] += 1
             self.pending.put(req)
         return req
+
+    def _finish_presubmit(self, req: GenRequest, *, truncated: bool) -> None:
+        """Complete a request at submit time without queueing it — a
+        resumed continuation that is already done (budget/eos reached on
+        the source replica) or can no longer fit this engine's caps."""
+        req.truncated = truncated
+        req.finished_at = time.perf_counter()
+        with self._lock:
+            self.stats["requests"] += 1
+            self.stats["completed"] += 1
+        if req.stream is not None:
+            req.stream.put(None)
+        req.done.set()
 
     def generate(self, prompt_ids: list[int], **kw) -> list[int]:
         """Blocking convenience wrapper."""
@@ -449,6 +523,78 @@ class LLMEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+
+    def drain(self, timeout_s: float) -> dict:
+        """Drain protocol: stop admission, let in-flight decodes finish,
+        export whatever the window didn't cover as resumable
+        continuations `(request_id, prompt_ids, generated_ids,
+        max_tokens, sampling params)`.
+
+        After drain() returns, the engine accepts no new work and every
+        request has either completed normally or carries migrated=True —
+        the actor can be killed without losing a client-visible token:
+        stream readers see the migrated flag and resubmit the
+        continuation to a surviving replica (cursor-exact splice via the
+        teacher-forced re-prefill in submit())."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                # _mid_tick fences the admission window: a request popped
+                # from `pending` but not yet slot-bound would otherwise
+                # read as idle and be truncated by the kill that follows.
+                busy = (self._mid_tick
+                        or any(r is not None for r in self.slot_req)
+                        or self.pending.qsize() > 0
+                        or len(self._deferred) > 0)
+            if not busy:
+                break
+            time.sleep(0.02)
+        continuations = self._export_unfinished()
+        return {"drained": not continuations,
+                "exported": len(continuations),
+                "continuations": continuations}
+
+    def _export_unfinished(self) -> list[dict]:
+        """Evict every unfinished request as a resumable continuation.
+        The engine thread is stopped FIRST so no tick races the export
+        (a request must never emit a token after its continuation left)."""
+        if self._thread is not None:
+            self.stop()
+        doomed: list[GenRequest] = []
+        with self._lock:
+            for slot, req in enumerate(self.slot_req):
+                if req is not None:
+                    doomed.append(req)
+                    self.slot_req[slot] = None
+            self._prefilling.clear()
+            self._chunk_pos.clear()
+            doomed.extend(self._deferred)
+            self._deferred.clear()
+            while True:
+                try:
+                    doomed.append(self.pending.get_nowait())
+                except queue.Empty:
+                    break
+        out = []
+        for req in doomed:
+            out.append({
+                "request_id": req.request_id,
+                # prompt_ids may have regrown past n_prompt on preempt
+                # (context = prompt + generated); split so the consumer
+                # never double-forces generated tokens.
+                "prompt_ids": [int(t) for t in req.prompt_ids[:req.n_prompt]],
+                "generated_ids": [int(t) for t in req.out_ids],
+                "max_tokens": req.max_tokens,
+                "temperature": req.temperature,
+                "eos_id": req.eos_id,
+            })
+            req.migrated = True
+            if req.stream is not None:
+                req.stream.put(None)
+            req.done.set()
+        return out
 
     def reset_stats(self) -> None:
         """Zero the counters (benchmarks call this after warmup so the
@@ -1125,6 +1271,15 @@ class LLMEngine:
         prefill token budget, then one fused decode window for every
         decode-ready slot. → slots that did work (decoding + prefilling).
         """
+        with self._lock:
+            self._mid_tick = True
+        try:
+            return self._step()
+        finally:
+            with self._lock:
+                self._mid_tick = False
+
+    def _step(self) -> int:
         rt = self._rt
         jnp = rt.jnp
         pt0 = self.stats["prefill_tokens"]
@@ -1154,6 +1309,10 @@ class LLMEngine:
             self._last_window_end = None
             return n_prefilling
         tick_prefill = self.stats["prefill_tokens"] > pt0
+        # Chaos fault point: a "kill" rule here exits the replica process
+        # abruptly with decodes in flight — the scenario the cross-replica
+        # failover path must make invisible to clients.
+        _chaos.hit("llm.decode_window")
         k = self._pick_window(active)
         table_view = None
         if self.kv_mode == "paged":
@@ -1319,6 +1478,12 @@ class LLMDeployment:
             eos_id=eos_id)
         req.done.wait()
         _observe_request_metrics(req, tags)
+        if req.migrated:
+            # Drain export raced this in-flight call: the proxy/handle
+            # treats "migrated"/"draining" errors as retriable-elsewhere
+            # (the unary path is side-effect-free to re-run in full).
+            raise RuntimeError(
+                "request migrated off draining replica: resubmit")
         if req.error:
             raise RuntimeError(req.error)
         return {
@@ -1349,6 +1514,11 @@ class LLMDeployment:
             max_tokens=request.get("max_tokens", 64),
             temperature=request.get("temperature", 0.0),
             eos_id=request.get("eos_id"),
+            # Failover resume: tokens the client already received from a
+            # dead/drained replica, teacher-forced so the stream cursor
+            # splices exactly (see LLMEngine.submit).
+            generated_ids=request.get("generated_ids"),
+            request_id=request.get("request_id"),
         )
         self._streams[req.request_id] = req
         return req.request_id
@@ -1360,6 +1530,7 @@ class LLMDeployment:
         if req is None:
             return {"tokens": [], "done": True,
                     "error": f"unknown stream {request_id!r}"}
+        req.last_read_at = time.perf_counter()
         deadline = time.perf_counter() + timeout_s
         while (len(req.out_ids) <= cursor and not req.done.is_set()
                and time.perf_counter() < deadline):
@@ -1367,6 +1538,11 @@ class LLMDeployment:
         toks = [int(t) for t in req.out_ids[cursor:]]
         done = req.done.is_set() and cursor + len(toks) >= len(req.out_ids)
         out = {"tokens": toks, "done": done}
+        if req.migrated:
+            # Drain export: the reader drains the local tail, then
+            # resubmits `(prompt, tokens so far)` to a surviving replica
+            # — done=True here ends only THIS replica's leg of the stream.
+            out["migrated"] = True
         if req.error:
             out["error"] = req.error
         if done:
@@ -1388,6 +1564,33 @@ class LLMDeployment:
 
     def metrics(self) -> dict:
         return self.engine.metrics()
+
+    def drain(self, timeout_s: float) -> dict:
+        """Replica drain (called by Replica.drain on controller
+        scale-down / version roll): stop admission, let in-flight
+        decodes finish, export the rest as continuations — then hold the
+        remaining window for stream readers to drain their cursors, so
+        in the common case the tail tokens leave over THIS replica's
+        stream instead of being re-decoded elsewhere."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        out = self.engine.drain(timeout_s)
+        # Hold only for streams a reader is ACTIVELY consuming (touched
+        # within the grace window): an abandoned record — client gone
+        # mid-stream, nobody will ever read it out — must not cost every
+        # scale-down the full drain window. Its tail tokens are not lost
+        # either way; a resumed reader re-decodes them elsewhere.
+        grace = 1.0
+        while time.monotonic() < deadline:
+            now = time.perf_counter()
+            streams = getattr(self, "_streams", {}) or {}
+            if not any(
+                    now - (r.last_read_at if r.last_read_at is not None
+                           else r.submitted_at) < grace
+                    for r in list(streams.values())):
+                break
+            time.sleep(0.05)
+        out["unread_streams"] = len(getattr(self, "_streams", {}) or {})
+        return out
 
     def load_snapshot(self) -> dict:
         """Live engine load — picked up by Replica.stats() on every

@@ -12,6 +12,7 @@ import threading
 import time
 from typing import Any
 
+from ray_tpu import chaos as _chaos
 from ray_tpu import profiling, tracing
 from ray_tpu.core import serialization
 
@@ -22,6 +23,15 @@ _EXEC_LATENCY = profiling.Histogram(
     description="Replica user-code execution time per request",
     boundaries=profiling.LATENCY_BUCKETS_S,
     tag_keys=("deployment",))
+
+# Methods a DRAINING replica still serves: stream readers must drain
+# their cursors (stream_read) and the control plane must keep observing
+# the replica; everything else is new work and is rejected so the
+# caller's failover re-picks a live replica.
+_DRAIN_ALLOWED = frozenset((
+    "stream_read", "health", "stats", "metrics", "load_snapshot",
+    "num_inflight",
+))
 
 
 class Replica:
@@ -35,6 +45,7 @@ class Replica:
         self._inflight = 0
         self._lock = threading.Lock()
         self._processed = 0
+        self._draining = False
         # Idle clock for scale-to-zero: time since the last request
         # FINISHED (or since construction) — a freshly cold-started replica
         # reads as "busy" until the waking request has had its chance.
@@ -93,6 +104,53 @@ class Replica:
                 os._exit(0)
 
     def health(self) -> bool:
+        _chaos.hit("serve.replica.probe")
+        return True
+
+    def drain(self, timeout_s: float | None = None) -> dict:
+        """Drain protocol (controller scale-down / version roll): stop
+        admitting new work, give in-flight requests up to `timeout_s` to
+        finish, and report what remains. A callable exposing drain()
+        (e.g. LLMDeployment) runs its own protocol first — finishing or
+        exporting live decodes as resumable continuations — then the
+        generic in-flight wait covers whatever handle_request calls are
+        still unwinding. The controller hard-kills the actor only after
+        this returns (or after the deadline passes without an answer)."""
+        from ray_tpu.core.config import runtime_config
+
+        if timeout_s is None:
+            timeout_s = runtime_config().serve_drain_timeout_s
+        self._draining = True
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        info: dict = {}
+        fn = getattr(self.callable, "drain", None)
+        if fn is not None:
+            try:
+                info = dict(fn(timeout_s) or {})
+            except Exception as e:
+                # The generic in-flight wait below still bounds the
+                # drain; a broken user drain() must not wedge scale-down.
+                logger.warning("callable drain() failed on %s: %s",
+                               type(self.callable).__name__, e)
+                info = {"drain_error": str(e)}
+        while time.monotonic() < deadline:
+            with self._lock:
+                n = self._inflight
+            if n <= 0:
+                break
+            time.sleep(0.05)
+        with self._lock:
+            n = self._inflight
+        info["inflight"] = n
+        info.setdefault("exported", 0)
+        info["drained"] = n <= 0 and not info.get("drain_error") and (
+            info.get("drained", True))
+        return info
+
+    def install_chaos(self, rules) -> bool:
+        """Arm a chaos spec in THIS replica process (fault-injection
+        tests target one victim of a fleet; see ray_tpu/chaos.py)."""
+        _chaos.install(rules)
         return True
 
     def reconfigure(self, user_config: Any) -> bool:
@@ -105,6 +163,7 @@ class Replica:
         return self._inflight
 
     def stats(self) -> dict:
+        _chaos.hit("serve.replica.probe")
         # Live engine load (flight recorder): a callable exposing
         # load_snapshot() — e.g. LLMDeployment — rides its numbers on the
         # controller's existing stats probe, no extra RPC.
@@ -129,6 +188,13 @@ class Replica:
         return out
 
     def handle_request(self, method: str, args: tuple, kwargs: dict):
+        _chaos.hit("serve.replica.request")
+        if self._draining and method not in _DRAIN_ALLOWED:
+            # Admission stopped: the caller's failover path re-picks a
+            # live replica ("draining" in the message is the contract).
+            raise RuntimeError(
+                f"replica draining: rejecting {method!r} — resubmit to "
+                "another replica")
         dep = getattr(self, "_deployment_name", None) or type(
             self.callable).__name__
         with self._lock:
