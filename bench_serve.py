@@ -65,8 +65,35 @@ def main() -> None:
     ap.add_argument("--prefill-budget", type=int, default=None,
                     help="max prefill tokens per engine tick while decode"
                          " is active (default: llm_prefill_token_budget)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="paged-KV prefix cache (serve/prefix_cache.py):"
+                         " completed requests donate chunk-aligned prefix"
+                         " pages; warm admissions skip prefill up to the"
+                         " first cold token (requires --prefill-chunk)")
+    ap.add_argument("--prefix-cache-pages", type=int, default=None,
+                    help="max pool pages cache entries may pin"
+                         " (default: half the pool)")
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                    help="fraction of each prompt drawn from a small pool"
+                         " of shared system prefixes (the millions-of-"
+                         "users workload: same system prompt, different"
+                         " user suffix). 0 = fully distinct prompts")
+    ap.add_argument("--prefix-pool", type=int, default=4,
+                    help="how many distinct shared prefixes the workload"
+                         " rotates through")
+    ap.add_argument("--turns", type=int, default=1,
+                    help="multi-turn conversations: each request's context"
+                         " = its previous turns' context + response + a"
+                         " fresh user message (every turn after the first"
+                         " re-submits a prefix the engine just decoded)")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
+    if not 0.0 <= args.shared_prefix_frac <= 1.0:
+        ap.error("--shared-prefix-frac must be in [0, 1]")
+    if args.turns < 1:
+        ap.error("--turns must be >= 1")
+    if args.prefix_pool < 1:
+        ap.error("--prefix-pool must be >= 1")
     if args.max_tokens_spread < 0:
         ap.error("--max-tokens-spread must be >= 0")
     if args.max_tokens_spread >= args.max_tokens:
@@ -100,8 +127,17 @@ def main() -> None:
                        kv_mode=args.kv_mode, page_size=args.page_size,
                        n_pages=args.n_pages, attn_impl=args.attn_impl,
                        prefill_chunk=args.prefill_chunk,
-                       prefill_token_budget=args.prefill_budget)
+                       prefill_token_budget=args.prefill_budget,
+                       prefix_cache=args.prefix_cache or None,
+                       prefix_cache_pages=args.prefix_cache_pages)
     rng = np.random.default_rng(0)
+    # Shared-prefix workload: a small pool of "system prompts" that a
+    # fraction of every prompt is drawn from. Built up front so the
+    # multiset is deterministic regardless of client scheduling.
+    shared_len = int(round(args.shared_prefix_frac * args.prompt_len))
+    prefix_pool = [
+        list(map(int, rng.integers(0, cfg.vocab_size, shared_len)))
+        for _ in range(args.prefix_pool)] if shared_len else []
 
     # Warm every admission-group size (8/4/2/1 batched prefill) and every
     # decode-window size the measured requests will hit. The engine thread
@@ -152,15 +188,28 @@ def main() -> None:
                 if not todo:
                     return
                 i = todo.pop()
-            ids = list(rng.integers(0, cfg.vocab_size, args.prompt_len))
-            req = engine.submit(ids, max_tokens=budgets[i])
-            req.done.wait(600)
-            if req.error:
-                continue
-            with lock:
-                results.append((req.first_token_at - req.submitted_at,
-                                req.finished_at - req.submitted_at,
-                                len(req.out_ids)))
+            uniq = args.prompt_len - shared_len
+            ids = (list(prefix_pool[i % len(prefix_pool)]) if prefix_pool
+                   else []) + list(rng.integers(0, cfg.vocab_size, uniq))
+            # --turns > 1: one conversation per request slot — every turn
+            # after the first re-submits context the engine just served
+            # (prompt + response + fresh user message), the multi-turn
+            # reuse pattern the prefix cache turns into warm admissions.
+            for _turn in range(args.turns):
+                try:
+                    req = engine.submit(ids, max_tokens=budgets[i])
+                except ValueError:
+                    break       # conversation outgrew the engine's caps
+                req.done.wait(600)
+                if req.error:
+                    break
+                with lock:
+                    results.append((req.first_token_at - req.submitted_at,
+                                    req.finished_at - req.submitted_at,
+                                    len(req.out_ids), req.cached_tokens))
+                ids = (ids + [int(t) for t in req.out_ids]
+                       + list(rng.integers(0, cfg.vocab_size,
+                                           max(1, uniq))))
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=client) for _ in range(args.clients)]
@@ -213,6 +262,9 @@ def main() -> None:
         "prefill_budget": (args.prefill_budget if args.prefill_budget
                            is not None else engine.prefill_budget),
         "prefill_chunks_dispatched": em.get("prefill_chunks", 0),
+        "shared_prefix_frac": args.shared_prefix_frac,
+        "prefix_pool": args.prefix_pool if shared_len else 0,
+        "turns": args.turns,
         "slot_occupancy": round(em.get("slot_occupancy", 0.0), 4),
         "decode_time_s": round(em.get("decode_time_s", 0.0), 2),
         "prefill_time_s": round(em.get("prefill_time_s", 0.0), 2),
@@ -237,6 +289,32 @@ def main() -> None:
         # Which attention implementation produced this row — kernel vs
         # gather ablations must be distinguishable from the JSON alone.
         row["llm_attn_impl"] = em.get("llm_attn_impl", engine.attn_impl)
+    row["prefix_cache"] = bool(engine.prefix_cache is not None)
+    if engine.prefix_cache is not None:
+        # Warm-vs-cold TTFT split (client-observed AND engine-side): the
+        # committed warm-prefix ablation's headline is the warm p50 —
+        # prefill collapses to the cold suffix, so it must sit well
+        # under the cache-off p50 at req/s parity.
+        warm = sorted(r[0] for r in results if r[3] > 0)
+        cold = sorted(r[0] for r in results if r[3] == 0)
+        row["warm_requests"] = len(warm)
+        row["cold_requests"] = len(cold)
+        if warm:
+            row["ttft_warm_p50_ms"] = round(warm[len(warm) // 2] * 1000, 1)
+            row["ttft_warm_p95_ms"] = round(
+                warm[int(len(warm) * 0.95)] * 1000, 1)
+        if cold:
+            row["ttft_cold_p50_ms"] = round(cold[len(cold) // 2] * 1000, 1)
+        row["engine_ttft_warm_ms_p50"] = em.get("ttft_warm_ms_p50", 0.0)
+        row["engine_ttft_warm_ms_p95"] = em.get("ttft_warm_ms_p95", 0.0)
+        row["engine_ttft_cold_ms_p50"] = em.get("ttft_cold_ms_p50", 0.0)
+        row["prefix_cache_hit_rate"] = em.get("prefix_cache_hit_rate", 0.0)
+        row["prefix_cache_hits"] = em.get("prefix_hits", 0)
+        row["prefix_cache_misses"] = em.get("prefix_misses", 0)
+        row["prefix_cache_evictions"] = em.get("prefix_evictions", 0)
+        row["prefix_cache_cow_copies"] = em.get("cow_copies", 0)
+        row["prefix_cached_tokens"] = em.get("prefix_cached_tokens", 0)
+        row["prefix_cache_pages"] = em.get("prefix_cache_pages", 0)
     print(json.dumps(row), flush=True)
     if args.json_out:
         json.dump(row, open(args.json_out, "w"))

@@ -286,6 +286,19 @@ class Config:
     # decoding); otherwise must be >= llm_prefill_chunk. Ignored unless
     # llm_prefill_chunk > 0.
     llm_prefill_token_budget: int = 256
+    # Paged-KV prefix cache (serve/prefix_cache.py): completed requests
+    # donate their chunk-aligned prefix pages (refcounted, read-only)
+    # and admission binds the longest cached prefix into a new slot's
+    # page table — chunked prefill then starts at the first COLD token,
+    # so warm-prefix TTFT collapses to the cold suffix + first decode.
+    # Requires kv_mode="paged" AND llm_prefill_chunk > 0 (the cache
+    # granularity IS the prefill chunk). Env: RAY_TPU_LLM_PREFIX_CACHE=1.
+    llm_prefix_cache: bool = False
+    # Max distinct pool pages cache entries may pin (the budget a
+    # pressure-aware LRU evicts against; zero-ref entries are always
+    # evicted before the scheduler preempts a live decode). 0 = auto:
+    # half the page pool.
+    llm_prefix_cache_pages: int = 0
 
     # --- flight recorder (compile watch + SLO monitor) ---
     # Recompile-storm alarm (ray_tpu/compile_watch.py): a structured

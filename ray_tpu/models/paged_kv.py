@@ -28,8 +28,13 @@ XLA-first layout decisions:
     HBM. Exact-match with ``"gather"`` within fp32-softmax
     reassociation (tested); the throughput path on real chips.
 - Writes scatter at ``(table[b, pos // ps], pos % ps)``. Distinct live
-  slots never share a page, so scatter indices never collide on real
-  pages.
+  slots never share a *writable* page: exclusively-owned pages are the
+  common case, and the prefix cache (serve/prefix_cache.py) may bind
+  the same already-written page into several slots' tables READ-ONLY —
+  every binder's writes start past the shared run, and a prefix tail
+  that would be written mid-page is duplicated first via
+  ``copy_pages`` (copy-on-write). So scatter indices still never
+  collide on real pages.
 
 Page allocation/free is host-side engine policy (ray_tpu.serve.llm):
 admission back-pressure, window-bounded lazy allocation, and
@@ -52,6 +57,22 @@ def init_paged_kv(cfg: GPTConfig, n_pages: int, page_size: int):
     """Shared page pool. Row 0 is the null page (never allocated)."""
     shape = (cfg.n_layers, n_pages + 1, page_size, cfg.n_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def copy_pages(pool, src, dst):
+    """Copy-on-write for the prefix cache: duplicate pages ``src[i]`` →
+    ``dst[i]`` across every layer for both K and V in ONE fused dispatch.
+
+    The engine batches a tick's COW copies into a single call (src/dst
+    padded to a power-of-two length so the copy lowers one program per
+    width bucket, not one per count). Padding pairs are ``(0, 0)``:
+    writes to the null page are harmless by layout convention, and
+    copying the null page onto itself is a no-op whatever the duplicate
+    write order. Real ``dst`` ids are freshly-allocated (never aliased),
+    so scatter order between real pairs cannot matter either.
+    """
+    return {k: v.at[:, dst].set(v[:, src]) for k, v in pool.items()}
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
@@ -316,6 +337,6 @@ def decode_multi_paged(cfg: GPTConfig, params, tokens, pool, positions,
 
 
 __all__ = [
-    "init_paged_kv", "prefill_batch_paged", "prefill_chunk_paged",
-    "decode_step_paged", "decode_multi_paged",
+    "init_paged_kv", "copy_pages", "prefill_batch_paged",
+    "prefill_chunk_paged", "decode_step_paged", "decode_multi_paged",
 ]

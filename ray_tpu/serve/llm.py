@@ -99,6 +99,26 @@ _LOAD_GAUGES = {
          "EWMA of per-tick prefill-budget utilization"),
         ("ttft_ewma_ms", "EWMA of time-to-first-token (ms)"),
         ("decode_tok_s_ewma", "EWMA of fused-window decode rate (tok/s)"),
+        ("prefix_cache_pages",
+         "KV pages currently pinned by prefix-cache entries"),
+        ("prefix_cache_hit_rate",
+         "Prefix-cache admission hit rate since last stats reset"),
+    )
+}
+
+# Prefix-cache lifecycle counters (serve/prefix_cache.py): cumulative,
+# flushed with the hosting worker's metrics like every other serve
+# counter, so hit/miss/eviction/COW rates are visible at /metrics and
+# through the replica stats -> serve.status() -> /api/serve/load chain.
+_PREFIX_COUNTERS = {
+    name: _profiling.Counter(
+        f"llm_prefix_cache_{name}_total", description=desc,
+        tag_keys=("replica",))
+    for name, desc in (
+        ("hits", "Admissions that bound a cached prefix"),
+        ("misses", "Admissions with no cached prefix"),
+        ("evictions", "Prefix-cache entries evicted (LRU / pressure)"),
+        ("cow_copies", "Copy-on-write page duplications at bind time"),
     )
 }
 
@@ -166,6 +186,15 @@ class GenRequest:
     # it sat page-blocked at the queue head. Past _ADMIT_BYPASS_LIMIT the
     # head blocks all lookahead until it admits (starvation guard).
     admit_bypasses: int = 0
+    # Prefix-cache hit at admission: tokens served from cached pages
+    # (prefill started at this offset instead of 0). Benchmarks split
+    # TTFT warm-vs-cold on it.
+    cached_tokens: int = 0
+    # Memoized chunk-hash chain over prompt_ids (prefix_cache.extend_
+    # chain): contexts only grow (preempt appends generated tokens) and
+    # the chain is parent-chained, so a page-blocked request re-scanned
+    # every admission round hashes each chunk once, not once per tick.
+    prefix_hashes: list = dataclasses.field(default_factory=list)
     out_ids: list[int] = dataclasses.field(default_factory=list)
     truncated: bool = False   # finished early (capacity/unresumable preempt)
     # Exported off a draining/dying engine as a resumable continuation:
@@ -193,7 +222,9 @@ class LLMEngine:
                  kv_mode: str | None = None, page_size: int | None = None,
                  n_pages: int | None = None, attn_impl: str | None = None,
                  prefill_chunk: int | None = None,
-                 prefill_token_budget: int | None = None):
+                 prefill_token_budget: int | None = None,
+                 prefix_cache: bool | None = None,
+                 prefix_cache_pages: int | None = None):
         import types
 
         import jax
@@ -230,6 +261,7 @@ class LLMEngine:
                                  "decode_step_paged"),
             decode_multi_paged=_w(_paged.decode_multi_paged,
                                   "decode_multi_paged"),
+            copy_pages=_w(_paged.copy_pages, "copy_pages"),
         )
         self.cfg = cfg
         self.n_slots = n_slots
@@ -244,8 +276,10 @@ class LLMEngine:
         self.params = params if params is not None else gpt.init_params(
             cfg, jax.random.key(seed))
         chunk_explicit = prefill_chunk is not None
+        cache_explicit = prefix_cache is not None
         if (kv_mode is None or page_size is None or attn_impl is None
-                or prefill_chunk is None or prefill_token_budget is None):
+                or prefill_chunk is None or prefill_token_budget is None
+                or prefix_cache is None or prefix_cache_pages is None):
             from ray_tpu.core.config import runtime_config
 
             _rc = runtime_config()
@@ -259,11 +293,29 @@ class LLMEngine:
             prefill_token_budget = (
                 _rc.llm_prefill_token_budget if prefill_token_budget is None
                 else prefill_token_budget)
+            prefix_cache = (_rc.llm_prefix_cache if prefix_cache is None
+                            else prefix_cache)
+            prefix_cache_pages = (
+                _rc.llm_prefix_cache_pages if prefix_cache_pages is None
+                else prefix_cache_pages)
         if prefill_chunk and kv_mode != "paged" and not chunk_explicit:
             # The global llm_prefill_chunk knob applies to paged engines;
             # a dense engine alongside it just keeps one-shot admission
             # (an EXPLICIT dense+chunk arg still errors below).
             prefill_chunk = 0
+        if prefix_cache and not (kv_mode == "paged" and prefill_chunk):
+            if cache_explicit:
+                raise ValueError(
+                    "prefix_cache requires kv_mode='paged' AND "
+                    "prefill_chunk > 0 (the cache granularity is the "
+                    f"prefill chunk); got kv_mode={kv_mode!r}, "
+                    f"prefill_chunk={prefill_chunk}")
+            # Global knob alongside an incompatible engine: soft-off,
+            # like the llm_prefill_chunk knob above.
+            prefix_cache = False
+        if prefix_cache_pages < 0:
+            raise ValueError(
+                f"prefix_cache_pages must be >= 0, got {prefix_cache_pages}")
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
         if attn_impl not in ("gather", "kernel"):
@@ -325,12 +377,37 @@ class LLMEngine:
             self.slot_n_pages = np.zeros(n_slots, np.int64)
             # pop() hands out ascending ids; 0 stays reserved (null page).
             self.free_pages = list(range(n_pages, 0, -1))
+            # Per-page reference counts: slots' tables AND prefix-cache
+            # entries each hold one ref; a page returns to free_pages
+            # only when the LAST ref drops (exclusive pages — refcount 1
+            # — behave exactly like the pre-cache allocator).
+            self.page_refs = np.zeros(n_pages + 1, np.int32)
             # Low-water mark of the free list (peak pool occupancy =
             # total - min_free): benches commit it so pool-pressure
             # regressions show up in JSONs, not just preemption counts.
             self._min_free_pages = n_pages
         else:
             self.cache = init_kv_cache(cfg, n_slots, max_len)
+        # Prefix cache (serve/prefix_cache.py): refcounted COW page
+        # sharing across requests — admission binds the longest cached
+        # chunk-aligned prefix and chunked prefill starts at the first
+        # cold token. None = off (exact pre-cache engine behavior).
+        self.prefix_cache = None
+        if prefix_cache:
+            from ray_tpu.serve.prefix_cache import PrefixCache
+
+            budget = (min(prefix_cache_pages, self.n_pages)
+                      if prefix_cache_pages else max(1, self.n_pages // 2))
+            self.prefix_cache = PrefixCache(
+                chunk=prefill_chunk, page_size=page_size,
+                max_pages=budget, ref_page=self._ref_page,
+                unref_page=self._unref_page)
+        # slot -> pinned CacheEntry while the slot is live (released on
+        # free/preempt), and the tick's pending COW (src, dst) pairs,
+        # flushed in one fused device copy per tick (_apply_cow).
+        self._slot_entry: dict[int, Any] = {}
+        self._cow_pairs: list[tuple[int, int]] = []
+        self._evictions_synced = 0
         self.tokens = np.zeros(n_slots, np.int32)
         self.positions = np.zeros(n_slots, np.int32)
         self.temps = np.zeros(n_slots, np.float32)
@@ -371,6 +448,13 @@ class LLMEngine:
         # token budget bounds (bench_serve commits both).
         self._ttft_ms: "collections.deque[float]" = collections.deque(
             maxlen=4096)
+        # Warm/cold TTFT split (prefix cache): warm = admission bound a
+        # cached prefix (cached_tokens > 0). The committed warm-prefix
+        # bench reads its headline off these.
+        self._ttft_warm_ms: "collections.deque[float]" = collections.deque(
+            maxlen=4096)
+        self._ttft_cold_ms: "collections.deque[float]" = collections.deque(
+            maxlen=4096)
         self._burst_step_ms: "collections.deque[float]" = collections.deque(
             maxlen=4096)
         self._last_window_end: float | None = None
@@ -407,7 +491,11 @@ class LLMEngine:
                       "prefill_chunks": 0,
                       "decode_time_s": 0.0, "decode_windows": 0,
                       "slot_step_sum": 0, "slot_cap_sum": 0,
-                      "preemptions": 0}
+                      "preemptions": 0,
+                      # Prefix-cache lifecycle (zeros unless enabled).
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_evictions": 0, "cow_copies": 0,
+                      "prefix_cached_tokens": 0}
 
     # ------------------------------------------------------------- API
 
@@ -577,6 +665,19 @@ class LLMEngine:
                     doomed.append(self.pending.get_nowait())
                 except queue.Empty:
                     break
+        if self.kv_mode == "paged":
+            # The engine thread is stopped: return every evicted slot's
+            # pages (decrement-only — prefix-cache entries keep theirs,
+            # so a drained-but-not-killed engine still closes the page
+            # accounting: free + cached == total).
+            for slot in range(self.n_slots):
+                entry = self._slot_entry.pop(slot, None)
+                if entry is not None:
+                    self.prefix_cache.release(entry)
+                if int(self.slot_n_pages[slot]):
+                    self._free_slot_pages(slot)
+                self.positions[slot] = 0
+                self.tokens[slot] = 0
         out = []
         for req in doomed:
             out.append({
@@ -604,6 +705,8 @@ class LLMEngine:
                 self.stats[k] = 0 if isinstance(v, int) else 0.0
             self._step_ms.clear()
             self._ttft_ms.clear()
+            self._ttft_warm_ms.clear()
+            self._ttft_cold_ms.clear()
             self._burst_step_ms.clear()
             self._last_window_end = None
             self._ttft_ewma_ms = None
@@ -677,6 +780,21 @@ class LLMEngine:
                 m["prefill_chunk"] = self.prefill_chunk
                 m["prefill_token_budget"] = self.prefill_budget
                 m["prefilling_slots"] = len(self._prefilling)
+            if self.prefix_cache is not None:
+                m["prefix_cache"] = True
+                m["prefix_cache_entries"] = len(self.prefix_cache.entries)
+                m["prefix_cache_pages"] = self.prefix_cache.n_pages_cached()
+                m["prefix_cache_pages_budget"] = self.prefix_cache.max_pages
+                looked = m["prefix_hits"] + m["prefix_misses"]
+                if looked:
+                    m["prefix_cache_hit_rate"] = round(
+                        m["prefix_hits"] / looked, 4)
+                if self._ttft_warm_ms:
+                    (m["ttft_warm_ms_p50"],
+                     m["ttft_warm_ms_p95"]) = _ring_pctls(self._ttft_warm_ms)
+                if self._ttft_cold_ms:
+                    (m["ttft_cold_ms_p50"],
+                     m["ttft_cold_ms_p95"]) = _ring_pctls(self._ttft_cold_ms)
             if self._step_ms:
                 m["decode_step_ms_p50"], m["decode_step_ms_p95"] = (
                     _ring_pctls(self._step_ms))
@@ -748,6 +866,19 @@ class LLMEngine:
                 if self._budget_util_ewma is not None:
                     snap["prefill_budget_util"] = round(
                         self._budget_util_ewma, 4)
+            if self.prefix_cache is not None:
+                # Cached-pages + hit-rate ride the same probe chain as
+                # the rest of the load snapshot: Replica.stats() →
+                # controller reconcile → serve.status() /
+                # /api/serve/load / `ray_tpu status --serve`.
+                snap["prefix_cache_entries"] = len(self.prefix_cache.entries)
+                snap["prefix_cache_pages"] = (
+                    self.prefix_cache.n_pages_cached())
+                looked = (self.stats["prefix_hits"]
+                          + self.stats["prefix_misses"])
+                if looked:
+                    snap["prefix_cache_hit_rate"] = round(
+                        self.stats["prefix_hits"] / looked, 4)
         tags = {"replica": self._impl_tags()["replica"]}
         for key, gauge in _LOAD_GAUGES.items():
             # Absent fields (dense engine's pool, EWMAs cleared by
@@ -762,26 +893,103 @@ class LLMEngine:
         """Pages needed to cover writes up to position `last_pos`."""
         return last_pos // self.page_size + 1
 
+    def _alloc_page(self) -> int | None:
+        """One exclusive page off the free list (refcount 1), or None
+        when the pool is dry (callers reclaim/preempt)."""
+        if not self.free_pages:
+            return None
+        pg = self.free_pages.pop()
+        self.page_refs[pg] = 1
+        if len(self.free_pages) < self._min_free_pages:
+            self._min_free_pages = len(self.free_pages)
+        return pg
+
+    def _ref_page(self, pg: int) -> None:
+        self.page_refs[pg] += 1
+
+    def _unref_page(self, pg: int) -> None:
+        """Drop one reference; the page returns to the pool at zero.
+        Shared (prefix-cache) pages simply outlive any one holder."""
+        self.page_refs[pg] -= 1
+        if self.page_refs[pg] <= 0:
+            self.page_refs[pg] = 0
+            self.free_pages.append(int(pg))
+
+    def _cache_reclaim(self, need: int) -> None:
+        """Pressure valve: evict zero-active prefix-cache entries (LRU)
+        until `need` pages are free or nothing evictable remains — the
+        cache gives its pages back BEFORE the scheduler shrinks a
+        window or preempts a live decode."""
+        if self.prefix_cache is None:
+            return
+        while len(self.free_pages) < need:
+            if self.prefix_cache.evict_one() is None:
+                break
+        self._sync_cache_evictions()
+
+    def _sync_cache_evictions(self) -> None:
+        """Fold the cache's cumulative eviction count into the windowed
+        stats + Prometheus counter (evictions also happen inside
+        donate()'s budget enforcement, not just _cache_reclaim)."""
+        delta = self.prefix_cache.evictions - self._evictions_synced
+        if delta > 0:
+            self._evictions_synced = self.prefix_cache.evictions
+            self.stats["prefix_evictions"] += delta
+            _PREFIX_COUNTERS["evictions"].inc(
+                float(delta),
+                tags={"replica": self._impl_tags()["replica"]})
+
     def _grow_slot(self, slot: int, last_pos: int) -> bool:
         """Allocate pages so `slot` covers `last_pos`. All-or-nothing."""
         need = self._pages_for(last_pos) - int(self.slot_n_pages[slot])
         if need <= 0:
             return True
         if need > len(self.free_pages):
+            self._cache_reclaim(need)
+        if need > len(self.free_pages):
             return False
         for _ in range(need):
-            pg = self.free_pages.pop()
+            pg = self._alloc_page()
             self.page_table[slot, int(self.slot_n_pages[slot])] = pg
             self.slot_n_pages[slot] += 1
-        if len(self.free_pages) < self._min_free_pages:
-            self._min_free_pages = len(self.free_pages)
         return True
 
     def _free_slot_pages(self, slot: int) -> None:
         for i in range(int(self.slot_n_pages[slot])):
-            self.free_pages.append(int(self.page_table[slot, i]))
+            self._unref_page(int(self.page_table[slot, i]))
         self.page_table[slot, :] = 0
         self.slot_n_pages[slot] = 0
+
+    def page_accounting(self) -> dict:
+        """Closure check (tests + chaos triage): every pool page is
+        exactly one of free / referenced, and every reference is owned
+        by a slot table or a cache entry. Engine-thread-safe only when
+        the engine is stopped or driven synchronously."""
+        live: dict[int, int] = {}
+        for slot in range(self.n_slots):
+            for i in range(int(self.slot_n_pages[slot])):
+                pg = int(self.page_table[slot, i])
+                live[pg] = live.get(pg, 0) + 1
+        cached = (self.prefix_cache.cached_pages()
+                  if self.prefix_cache is not None else set())
+        allocated = set(live) | cached
+        refs_ok = all(
+            int(self.page_refs[pg]) == live.get(pg, 0) + (
+                self.prefix_cache.page_refs_held(pg)
+                if self.prefix_cache is not None else 0)
+            for pg in allocated)
+        free = len(self.free_pages)
+        return {
+            "total": self.n_pages,
+            "free": free,
+            "live": len(live),
+            "cached": len(cached),
+            "cached_only": len(cached - set(live)),
+            "shared": sum(1 for pg in live if live[pg] > 1 or pg in cached),
+            "closure": free + len(allocated) == self.n_pages,
+            "refs_consistent": refs_ok and not (
+                set(self.free_pages) & allocated),
+        }
 
     # ------------------------------------------------------------- engine
 
@@ -835,6 +1043,9 @@ class LLMEngine:
             with self._lock:
                 ms = (now - req.submitted_at) * 1000.0
                 self._ttft_ms.append(ms)
+                if self.prefix_cache is not None:
+                    (self._ttft_warm_ms if req.cached_tokens
+                     else self._ttft_cold_ms).append(ms)
                 self._ttft_ewma_ms = self._ewma(self._ttft_ewma_ms, ms)
             self._emit_ttft_spans(req)
         req.out_ids.append(token)
@@ -886,6 +1097,7 @@ class LLMEngine:
         free = [s for s in range(self.n_slots) if self.slot_req[s] is None]
         reqs: list[GenRequest] = []
         blocked: list[GenRequest] = []
+        hits: dict[str, Any] = {}
         head_mark = 0
         planned_pages = 0
         while len(reqs) < len(free):
@@ -896,16 +1108,41 @@ class LLMEngine:
                     req = self.pending.get_nowait()
                 except queue.Empty:
                     break
+            hit = None
             if self.kv_mode == "paged":
                 # Admission back-pressure: one-shot needs the whole prompt
                 # (plus first decode write) covered; chunked only the
-                # FIRST CHUNK — the rest is budgeted lazy growth.
+                # FIRST CHUNK — the rest is budgeted lazy growth. A warm
+                # prefix shrinks the reservation further: shared full
+                # pages come from the cache, so only the COW tail (if
+                # the prefix ends mid-page) plus the first COLD chunk's
+                # pages need the free list.
                 if self.prefill_chunk:
-                    first = min(self.prefill_chunk, len(req.prompt_ids))
-                    need = self._pages_for(first - 1)
+                    n_cached = 0
+                    if self.prefix_cache is not None:
+                        # Acquire (pin) at RESERVATION time: the reclaim
+                        # below evicts zero-active entries, and it must
+                        # not evict the entry this reservation is sized
+                        # for — an unpinned match could silently turn a
+                        # warm admission cold with an undersized page
+                        # reservation.
+                        hit = self.prefix_cache.acquire(
+                            req.prompt_ids, memo=req.prefix_hashes)
+                        if hit is not None:
+                            n_cached = hit.n_tokens
+                    end = min(n_cached + self.prefill_chunk,
+                              len(req.prompt_ids))
+                    need = (self._pages_for(end - 1)
+                            - n_cached // self.page_size)
                 else:
                     need = self._pages_for(len(req.prompt_ids))
                 if planned_pages + need > len(self.free_pages):
+                    self._cache_reclaim(planned_pages + need)
+                if planned_pages + need > len(self.free_pages):
+                    if hit is not None:
+                        # Not admitted this round: unpin (the entry is
+                        # re-acquired when the request is re-scanned).
+                        self.prefix_cache.release(hit)
                     if not blocked:
                         head_mark = len(reqs)
                         if req.admit_bypasses >= self._ADMIT_BYPASS_LIMIT:
@@ -916,6 +1153,8 @@ class LLMEngine:
                         break
                     continue
                 planned_pages += need
+            if hit is not None:
+                hits[req.request_id] = hit
             reqs.append(req)
         for req in reversed(blocked):
             self._deferred.appendleft(req)   # original order, at the head
@@ -926,13 +1165,20 @@ class LLMEngine:
         if self.prefill_chunk:
             # Chunked admission: bind request → slot now; the prompt
             # enters the pool chunk-by-chunk via _run_prefill_chunks.
+            # A prefix-cache hit pre-binds the cached page run into the
+            # slot's table and starts the chunk cursor at the first
+            # COLD token — the cached prefix is never re-prefilled.
             for req, slot in zip(reqs, free):
+                n_cached = 0
+                if self.prefix_cache is not None:
+                    n_cached = self._bind_cached_prefix(
+                        slot, req, hits.pop(req.request_id, None))
                 with self._lock:
                     self.slot_req[slot] = req
                 self.tokens[slot] = 0
                 self.positions[slot] = 0
                 self.temps[slot] = req.temperature
-                self._chunk_pos[slot] = 0
+                self._chunk_pos[slot] = n_cached
                 self._prefilling.append(slot)
             return
         by_bucket: dict[int, list[GenRequest]] = {}
@@ -948,6 +1194,84 @@ class LLMEngine:
                 group = group[n:]
                 slots = [next(slot_iter) for _ in batch]
                 self._prefill_group(bucket, batch, slots)
+
+    def _bind_cached_prefix(self, slot: int, req: GenRequest,
+                            entry) -> int:
+        """Warm admission: bind `entry` — the cached chunk-aligned
+        prefix of `req.prompt_ids` that _admit acquired (pinned) while
+        sizing the page reservation — into `slot`'s page table.
+
+        Full pages of the prefix are shared READ-ONLY (refcount bumped;
+        the binder's writes all land past them). If the prefix ends
+        mid-page, that tail page will be written by the cold suffix, so
+        a fresh page is allocated and a (src, dst) copy is queued —
+        flushed as ONE fused device copy per tick (_apply_cow). When no
+        page is free for the COW, the bind degrades to the full-page
+        part of the prefix (chunk prefill handles arbitrary offsets).
+        → tokens served from cache (the chunk cursor's start)."""
+        tags = {"replica": self._impl_tags()["replica"]}
+        # Reset before the verdict: a preempted warm request can
+        # re-admit COLD (its entry was evicted) and must not keep the
+        # stale warm classification.
+        req.cached_tokens = 0
+        if entry is None:
+            self.stats["prefix_misses"] += 1
+            _PREFIX_COUNTERS["misses"].inc(tags=tags)
+            return 0
+        ps = self.page_size
+        n_cached = entry.n_tokens
+        p_full = n_cached // ps
+        for i in range(p_full):
+            pg = entry.pages[i]
+            self._ref_page(pg)
+            self.page_table[slot, i] = pg
+        self.slot_n_pages[slot] = p_full
+        if n_cached % ps:
+            dst = self._alloc_page()
+            if dst is None:
+                # Pool dry for the divergence copy: fall back to the
+                # full-page part (re-prefill the partial tail's tokens).
+                n_cached = p_full * ps
+            else:
+                self.page_table[slot, p_full] = dst
+                self.slot_n_pages[slot] = p_full + 1
+                self._cow_pairs.append((int(entry.pages[p_full]), int(dst)))
+                self.stats["cow_copies"] += 1
+                _PREFIX_COUNTERS["cow_copies"].inc(tags=tags)
+        if n_cached <= 0:
+            # Degraded all the way to cold (prefix shorter than a page
+            # and no COW page free).
+            self.prefix_cache.release(entry)
+            self.stats["prefix_misses"] += 1
+            _PREFIX_COUNTERS["misses"].inc(tags=tags)
+            return 0
+        self._slot_entry[slot] = entry
+        req.cached_tokens = n_cached
+        self.stats["prefix_hits"] += 1
+        self.stats["prefix_cached_tokens"] += n_cached
+        _PREFIX_COUNTERS["hits"].inc(tags=tags)
+        return n_cached
+
+    def _apply_cow(self) -> None:
+        """Flush the tick's queued copy-on-write pairs as one fused
+        `copy_pages` dispatch. Pair counts are padded to a power of two
+        (capped at n_slots — at most one COW per admitted slot per
+        tick), so the copy lowers O(log n_slots) programs total;
+        padding pairs are (0, 0) null-page no-ops."""
+        if not self._cow_pairs:
+            return
+        rt = self._rt
+        pairs, self._cow_pairs = self._cow_pairs, []
+        width = 1
+        while width < len(pairs):
+            width *= 2
+        src = np.zeros(width, np.int32)
+        dst = np.zeros(width, np.int32)
+        for i, (s, d) in enumerate(pairs):
+            src[i] = s
+            dst[i] = d
+        self.cache = rt.copy_pages(
+            self.cache, rt.jnp.asarray(src), rt.jnp.asarray(dst))
 
     def _prefill_group(self, bucket, group, slots) -> None:
         """One-shot admission: whole-prompt prefill for a same-bucket
@@ -1157,15 +1481,39 @@ class LLMEngine:
 
     def _release(self, slot: int) -> None:
         """Free a slot. Positions reset so multi-step windows never walk an
-        idle slot's write cursor toward the cache boundary."""
+        idle slot's write cursor toward the cache boundary.
+
+        Insert-on-free: a request that completed cleanly donates its
+        chunk-aligned written prefix (prompt AND generated tokens — the
+        next turn of a chat re-prefills exactly this sequence) to the
+        prefix cache BEFORE its pages are unreffed, so the cache's own
+        refs keep the donated pages alive. Preempted/errored slots never
+        donate: a preempt exists to RECLAIM pages (donation would pin
+        them right back), and an error path's pages may be garbage."""
+        req = self.slot_req[slot]
         with self._lock:
             self.slot_req[slot] = None
+        if (self.prefix_cache is not None and req is not None
+                and req.done.is_set() and req.error is None
+                and not req.migrated):
+            # positions[slot] counts the slot's correctly-written leading
+            # positions in EVERY path (prefill graduation sets it to the
+            # prompt length; each decode write advances it; a mid-window
+            # finish just leaves this conservative).
+            n_written = int(self.positions[slot])
+            seq = (req.prompt_ids + req.out_ids)[:n_written]
+            self.prefix_cache.donate(seq, self.page_table[slot],
+                                     memo=req.prefix_hashes)
+            self._sync_cache_evictions()
         self.tokens[slot] = 0
         self.positions[slot] = 0
         self.temps[slot] = 0.0
         if slot in self._chunk_pos:      # mid-prefill slot going away
             self._chunk_pos.pop(slot, None)
             self._prefilling.remove(slot)
+        entry = self._slot_entry.pop(slot, None)
+        if entry is not None:
+            self.prefix_cache.release(entry)
         if self.kv_mode == "paged":
             self._free_slot_pages(slot)
 
@@ -1209,6 +1557,12 @@ class LLMEngine:
                     max(0, self._pages_for(int(self.positions[s]) + kk - 1)
                         - int(self.slot_n_pages[s]))
                     for s in active)
+                if extra > len(self.free_pages):
+                    # Cached pages are speculative value; a live decode
+                    # window is not. Zero-active prefix-cache entries
+                    # are evicted before the window shrinks — and long
+                    # before anything is preempted.
+                    self._cache_reclaim(extra)
                 if extra <= len(self.free_pages):
                     for s in active:
                         if not self._grow_slot(
@@ -1284,6 +1638,10 @@ class LLMEngine:
         jnp = rt.jnp
         pt0 = self.stats["prefill_tokens"]
         self._admit()
+        # COW flush MUST precede any dispatch that could write this
+        # tick: admission queued the pairs, and the first cold chunk of
+        # a warm slot writes into its COW'd tail page.
+        self._apply_cow()
         if self.prefill_chunk:
             decode_ready = any(
                 self.slot_req[i] is not None and i not in self._chunk_pos

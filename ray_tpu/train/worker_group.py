@@ -9,13 +9,76 @@ through a result queue).
 
 from __future__ import annotations
 
+import logging
 import os
+import subprocess
+import sys
 import threading
 import traceback
 from typing import Any
 
 import ray_tpu
 from ray_tpu.core import serialization
+
+logger = logging.getLogger(__name__)
+
+_COLL_TIMEOUT_FLAG = "--xla_cpu_collective_timeout_seconds"
+_coll_flag_supported: bool | None = None
+
+
+def _xla_accepts_collective_timeout() -> bool:
+    """Whether this jaxlib's XLA accepts ``--xla_cpu_collective_timeout_
+    seconds``. Some jaxlib builds don't ship the flag, and XLA reacts to
+    an unknown XLA_FLAGS entry by ABORTING the process at backend init
+    ("Unknown flags in XLA_FLAGS: ..."), so acceptance can't be tested
+    in-process: it is probed ONCE per process in a throwaway subprocess
+    that sets only this flag and initializes the CPU backend. Set
+    ``RAY_TPU_XLA_COLLECTIVE_TIMEOUT_FLAG=0|1`` to skip the probe and
+    force the verdict (gangs that know their jaxlib avoid the ~seconds
+    of probe cost per worker)."""
+    global _coll_flag_supported
+    forced = os.environ.get("RAY_TPU_XLA_COLLECTIVE_TIMEOUT_FLAG")
+    if forced is not None:
+        return forced.strip().lower() in ("1", "true", "yes")
+    if _coll_flag_supported is None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS=f"{_COLL_TIMEOUT_FLAG}=30")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=env, capture_output=True, timeout=120)
+            _coll_flag_supported = proc.returncode == 0
+        except Exception as e:  # probe infra failure: assume unsupported
+            logger.warning("XLA collective-timeout flag probe failed "
+                           "(%s); omitting the flag", e)
+            _coll_flag_supported = False
+        if not _coll_flag_supported:
+            logger.warning(
+                "this jaxlib rejects %s; CPU collectives keep XLA's "
+                "default op timeout (compile skew between gang members "
+                "on a loaded box may hit DEADLINE_EXCEEDED at the first "
+                "allreduce)", _COLL_TIMEOUT_FLAG)
+    return _coll_flag_supported
+
+
+def _cpu_worker_xla_flags(flags: str, devices_per_worker: int,
+                          coll_timeout_s: int, coll_flag_ok: bool) -> str:
+    """XLA_FLAGS for a CPU train worker: pin the device count (never
+    inherit the driver's virtual mesh) and, only when this jaxlib
+    accepts it, raise the CPU-collective op timeout. An INHERITED
+    timeout flag is stripped either way — a fleet-wide XLA_FLAGS export
+    on a jaxlib that rejects the flag would otherwise abort the worker
+    despite the gate (and on one that accepts it, leave a conflicting
+    duplicate)."""
+    import re
+
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags)
+    flags = re.sub(_COLL_TIMEOUT_FLAG + r"=\d+", "", flags)
+    flags += f" --xla_force_host_platform_device_count={devices_per_worker}"
+    if coll_flag_ok:
+        flags += f" {_COLL_TIMEOUT_FLAG}={coll_timeout_s}"
+    return " ".join(flags.split())
 
 
 class TrainWorker:
@@ -39,25 +102,17 @@ class TrainWorker:
         if platform:
             os.environ["JAX_PLATFORMS"] = platform
         if platform == "cpu":
-            # Pin this worker's device count — never inherit the driver's
-            # XLA_FLAGS (e.g. the test harness forces 8 virtual devices).
-            import re
-
             from ray_tpu.core.config import runtime_config
 
-            flags = os.environ.get("XLA_FLAGS", "")
-            flags = re.sub(
-                r"--xla_force_host_platform_device_count=\d+", "", flags
-            )
             # XLA's CPU collectives default to a 30s op timeout — on a
             # loaded box, compile skew between gang members can exceed it
             # at the first allreduce (DEADLINE_EXCEEDED "rendezvous").
+            # The raising flag is version-gated: jaxlibs that don't ship
+            # it ABORT the worker at backend init if it is set blindly.
             coll_t = int(runtime_config().train_cpu_collective_timeout_s)
-            os.environ["XLA_FLAGS"] = (
-                flags
-                + f" --xla_force_host_platform_device_count={devices_per_worker}"
-                + f" --xla_cpu_collective_timeout_seconds={coll_t}"
-            ).strip()
+            os.environ["XLA_FLAGS"] = _cpu_worker_xla_flags(
+                os.environ.get("XLA_FLAGS", ""), devices_per_worker,
+                coll_t, _xla_accepts_collective_timeout())
         import jax
 
         if platform:

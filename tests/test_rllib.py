@@ -249,7 +249,13 @@ class TestDQN:
                          num_atoms=51, v_min=0.0, v_max=100.0))
         algo = cfg.build()
         result = None
-        for _ in range(45):
+        # 60 iterations, not 45: the run is deterministic per environment,
+        # but the harness's 8-device virtual mesh (conftest) shifts the
+        # RNG stream vs a plain 1-device box — under it the curve sits at
+        # ~36 at iter 45, crosses 45 at ~48, and reaches ~99 by iter 60.
+        # The longer window passes with margin in BOTH environments
+        # (TESTING.md "c51 convergence" note).
+        for _ in range(60):
             result = algo.train()
         assert result["loss"] is not None and np.isfinite(result["loss"])
         assert result["episode_return_mean"] > 45, result

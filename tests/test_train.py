@@ -136,6 +136,71 @@ def test_report_callback_streaming(cluster):
     assert len(seen) > 1, "reports should stream in over multiple polls"
 
 
+class TestXlaCollectiveTimeoutGate:
+    """The CPU-collective timeout flag is version-gated: jaxlibs whose
+    XLA doesn't ship ``--xla_cpu_collective_timeout_seconds`` ABORT the
+    worker process at backend init when it's set blindly (the 4
+    test_train failures this gate fixed). The degrade — omit the flag,
+    keep XLA's default timeout — is pinned here."""
+
+    def test_flag_omitted_when_unsupported(self):
+        from ray_tpu.train import worker_group as wg
+
+        flags = wg._cpu_worker_xla_flags(
+            "--xla_force_host_platform_device_count=8", 2, 180,
+            coll_flag_ok=False)
+        assert "--xla_force_host_platform_device_count=2" in flags
+        assert wg._COLL_TIMEOUT_FLAG not in flags
+
+    def test_inherited_flag_stripped(self):
+        """A fleet-wide XLA_FLAGS export carrying the timeout flag must
+        not reach a rejecting jaxlib's worker (that abort is the bug the
+        gate exists for), nor duplicate on an accepting one."""
+        from ray_tpu.train import worker_group as wg
+
+        inherited = (f"{wg._COLL_TIMEOUT_FLAG}=300 "
+                     "--xla_force_host_platform_device_count=8")
+        flags = wg._cpu_worker_xla_flags(inherited, 2, 180,
+                                         coll_flag_ok=False)
+        assert wg._COLL_TIMEOUT_FLAG not in flags
+        flags = wg._cpu_worker_xla_flags(inherited, 2, 180,
+                                         coll_flag_ok=True)
+        assert flags.count(wg._COLL_TIMEOUT_FLAG) == 1
+        assert f"{wg._COLL_TIMEOUT_FLAG}=180" in flags
+
+    def test_flag_kept_when_supported(self):
+        from ray_tpu.train import worker_group as wg
+
+        flags = wg._cpu_worker_xla_flags("", 1, 180, coll_flag_ok=True)
+        assert f"{wg._COLL_TIMEOUT_FLAG}=180" in flags
+        assert "--xla_force_host_platform_device_count=1" in flags
+
+    def test_env_override_skips_probe(self, monkeypatch):
+        from ray_tpu.train import worker_group as wg
+
+        monkeypatch.setenv("RAY_TPU_XLA_COLLECTIVE_TIMEOUT_FLAG", "0")
+        assert wg._xla_accepts_collective_timeout() is False
+        monkeypatch.setenv("RAY_TPU_XLA_COLLECTIVE_TIMEOUT_FLAG", "1")
+        assert wg._xla_accepts_collective_timeout() is True
+
+    def test_probe_runs_and_memoizes(self, monkeypatch):
+        """The real probe returns a bool and is paid at most once per
+        process (workers call it on every setup_jax)."""
+        from ray_tpu.train import worker_group as wg
+
+        monkeypatch.delenv("RAY_TPU_XLA_COLLECTIVE_TIMEOUT_FLAG",
+                           raising=False)
+        monkeypatch.setattr(wg, "_coll_flag_supported", None)
+        first = wg._xla_accepts_collective_timeout()
+        assert isinstance(first, bool)
+
+        def boom(*a, **kw):  # pragma: no cover - must not be reached
+            raise AssertionError("probe subprocess ran twice")
+
+        monkeypatch.setattr(wg.subprocess, "run", boom)
+        assert wg._xla_accepts_collective_timeout() is first
+
+
 def test_checkpoint_dict_dir_roundtrip(tmp_path):
     ck = Checkpoint.from_dict({"a": 1, "params": {"w": np.ones(3)}})
     d = ck.to_directory(str(tmp_path / "ck"))
