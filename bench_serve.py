@@ -86,6 +86,30 @@ def main() -> None:
                          " = its previous turns' context + response + a"
                          " fresh user message (every turn after the first"
                          " re-submits a prefix the engine just decoded)")
+    ap.add_argument("--ramp", default=None,
+                    help="diurnal ramp: 'clients:seconds,...' phases"
+                         " (e.g. '32:20,256:40,32:40'). Replaces the"
+                         " fixed --clients/--requests run with timed"
+                         " phases of closed-loop clients; emits one row"
+                         " per phase (TTFT / burn-rate / recommended-"
+                         "replica columns) plus the shadow autoscaler's"
+                         " full decision trace — the ROADMAP"
+                         " autoscaling acceptance harness")
+    ap.add_argument("--ramp-sample-s", type=float, default=0.25,
+                    help="load-snapshot sampling cadence into the local"
+                         " series store during --ramp")
+    ap.add_argument("--autoscale-interval-s", type=float, default=1.0,
+                    help="shadow-autoscaler evaluation cadence (--ramp)")
+    ap.add_argument("--autoscale-window-s", type=float, default=10.0,
+                    help="policy window over the series store (--ramp)")
+    ap.add_argument("--target-ongoing", type=float, default=None,
+                    help="per-replica (inflight+queued) the policy sizes"
+                         " for (default: n_slots)")
+    ap.add_argument("--max-replicas", type=int, default=8,
+                    help="recommendation clamp for the shadow policy")
+    ap.add_argument("--slo-ttft-ms", type=float, default=1000.0,
+                    help="TTFT p95 SLO target driving the burn-rate"
+                         " signal during --ramp")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if not 0.0 <= args.shared_prefix_frac <= 1.0:
@@ -99,6 +123,15 @@ def main() -> None:
     if args.max_tokens_spread >= args.max_tokens:
         ap.error("--max-tokens-spread must be < --max-tokens"
                  " (a request must generate at least one token)")
+    phases = None
+    if args.ramp:
+        try:
+            phases = [(int(c), float(s)) for c, s in
+                      (part.split(":") for part in args.ramp.split(","))]
+        except ValueError:
+            ap.error("--ramp must be 'clients:seconds,...' phases")
+        if not phases or any(c < 1 or s <= 0 for c, s in phases):
+            ap.error("--ramp phases need clients >= 1 and seconds > 0")
 
     if args.model == "tiny":
         # CI path: force the CPU backend before jax initializes.
@@ -170,6 +203,10 @@ def main() -> None:
 
     compiles0 = compile_watch.compiles_total()
     engine.start()
+
+    if phases is not None:
+        _run_ramp(args, phases, engine, cfg, compiles0)
+        return
 
     results = []
     lock = threading.Lock()
@@ -318,6 +355,199 @@ def main() -> None:
     print(json.dumps(row), flush=True)
     if args.json_out:
         json.dump(row, open(args.json_out, "w"))
+
+
+def _run_ramp(args, phases, engine, cfg, compiles0) -> None:
+    """Diurnal ramp driver: timed phases of closed-loop clients against
+    the in-process engine, a sampler thread recording load snapshots and
+    the TTFT burn rate into a local SeriesStore (the same rings the GCS
+    runs), and a ShadowAutoscaler consuming that store — the
+    decision-plane dry run of the ROADMAP's SLO-driven autoscaling loop,
+    minus only the cluster transport. Emits one JSON doc: per-phase rows
+    (TTFT / burn-rate / recommended-replica columns), the full decision
+    trace, and the store's bounded-memory accounting."""
+    import dataclasses
+
+    from ray_tpu import compile_watch, profiling
+    from ray_tpu.core.config import Config
+    from ray_tpu.obs_series import SeriesStore
+    from ray_tpu.serve.autoscale import (AutoscalePolicy, ShadowAutoscaler,
+                                         TTFT_SLO)
+    # The serve replica wrapper observes this histogram per request; the
+    # bench drives the engine directly, so it observes the same series
+    # itself — the SloMonitor path stays the real one.
+    from ray_tpu.serve.llm import _TTFT_HIST
+    from ray_tpu.slo import Objective, SloMonitor
+
+    knobs = Config.from_env()
+    store = SeriesStore(
+        max_points=knobs.obs_series_points,
+        resolution_s=args.ramp_sample_s,
+        max_series=knobs.obs_series_max_series,
+        tombstone_ttl_s=knobs.obs_series_tombstone_ttl_s)
+    monitor = SloMonitor(
+        [Objective(TTFT_SLO, "serve_llm_ttft_s", 0.95,
+                   args.slo_ttft_ms / 1000.0,
+                   window_s=args.autoscale_window_s)],
+        rows_fn=profiling.metrics_snapshot, export=False, seed=False)
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=args.max_replicas,
+        window_s=args.autoscale_window_s,
+        target_ongoing=(args.target_ongoing
+                        if args.target_ongoing else float(args.n_slots)),
+        target_ttft_p95_ms=args.slo_ttft_ms,
+        up_sustain_s=2.0, down_sustain_s=8.0,
+        up_cooldown_s=3.0, down_cooldown_s=10.0)
+    autoscaler = ShadowAutoscaler(policy, series_fn=store.query,
+                                  emit_events=False)
+
+    stop = threading.Event()
+    phase_box = {"i": 0}
+    acc = [{"q_sum": 0.0, "q_n": 0, "q_max": 0.0, "burn_max": 0.0,
+            "rec_min": None, "rec_max": None, "rec_last": None}
+           for _ in phases]
+    # The virtual replica count follows the recommendation: shadow
+    # mode's trace IS the dry run of the closed loop, so the state
+    # machine must see its own moves (a live controller reads the
+    # actual replica count here).
+    virtual = {"replicas": 1}
+    tags = {"deployment": "bench", "replica": "r0"}
+
+    def sampler():
+        last_eval = 0.0
+        while not stop.is_set():
+            now = time.time()
+            snap = engine.load_snapshot()
+            qd = float(snap.get("queue_depth", 0))
+            store.record("serve_replica_queue_depth", qd, tags,
+                         source="bench", ts=now)
+            store.record("serve_replica_ongoing",
+                         qd + float(snap.get("active_slots", 0)), tags,
+                         source="bench", ts=now)
+            store.record("serve_replica_ttft_ewma_ms",
+                         float(snap.get("ttft_ewma_ms", 0.0)), tags,
+                         source="bench", ts=now)
+            burn = monitor.evaluate()[0]["burn_rate"]
+            store.record("slo_burn_rate", burn, {"slo": TTFT_SLO},
+                         source="bench", ts=now)
+            a = acc[phase_box["i"]]
+            a["q_sum"] += qd
+            a["q_n"] += 1
+            a["q_max"] = max(a["q_max"], qd)
+            a["burn_max"] = max(a["burn_max"], burn)
+            if now - last_eval >= args.autoscale_interval_s:
+                last_eval = now
+                rec = autoscaler.evaluate(
+                    "bench", virtual["replicas"])["recommended_replicas"]
+                virtual["replicas"] = rec
+                a["rec_last"] = rec
+                a["rec_min"] = (rec if a["rec_min"] is None
+                                else min(a["rec_min"], rec))
+                a["rec_max"] = (rec if a["rec_max"] is None
+                                else max(a["rec_max"], rec))
+            stop.wait(args.ramp_sample_s)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+
+    phase_rows = []
+    t_start = time.perf_counter()
+    for pi, (clients, dur) in enumerate(phases):
+        phase_box["i"] = pi
+        deadline = time.perf_counter() + dur
+        results: list = []
+        plock = threading.Lock()
+
+        def client(tid: int, pi=pi, deadline=deadline, results=results,
+                   plock=plock):
+            # Per-thread RNG (np.Generator is not thread-safe), seeded
+            # by (phase, thread) so the prompt multiset is deterministic
+            # given the phase schedule.
+            crng = np.random.default_rng(100_000 + pi * 1024 + tid)
+            while time.perf_counter() < deadline:
+                ids = list(map(int, crng.integers(
+                    0, cfg.vocab_size, args.prompt_len)))
+                try:
+                    req = engine.submit(ids, max_tokens=args.max_tokens)
+                except ValueError:
+                    break       # engine caps exceeded: stop this client
+                if (not req.done.wait(600) or req.error
+                        or req.first_token_at is None):
+                    continue    # wedged/failed request: count nothing
+                ttft = req.first_token_at - req.submitted_at
+                _TTFT_HIST.observe(ttft, tags={"route": "bench",
+                                               "replica": "r0"})
+                with plock:
+                    results.append((ttft, len(req.out_ids)))
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        a = acc[pi]
+        ttfts = sorted(r[0] for r in results)
+        row = {
+            "phase": pi, "clients": clients, "duration_s": dur,
+            "wall_s": round(wall, 2), "completed": len(results),
+            "req_per_s": round(len(results) / wall, 2),
+            "tok_per_s": round(sum(r[1] for r in results) / wall, 1),
+            "queue_depth_mean": round(a["q_sum"] / max(a["q_n"], 1), 2),
+            "queue_depth_max": a["q_max"],
+            "burn_rate_max": round(a["burn_max"], 3),
+            "recommended_replicas": a["rec_last"],
+            "recommended_min": a["rec_min"],
+            "recommended_max": a["rec_max"],
+        }
+        if ttfts:
+            row["ttft_p50_ms"] = round(ttfts[len(ttfts) // 2] * 1000, 1)
+            row["ttft_p95_ms"] = round(
+                ttfts[int(len(ttfts) * 0.95)] * 1000, 1)
+        phase_rows.append(row)
+    total_wall = time.perf_counter() - t_start
+    stop.set()
+    sampler_t.join(timeout=10)
+    engine.stop()
+
+    decisions = autoscaler.decisions("bench")
+    changes = [r for r in decisions if r["changed"]]
+    stats = store.stats()
+    doc = {
+        "metric": "serve_llm_ramp",
+        "model": args.model, "kv_mode": args.kv_mode,
+        "n_slots": args.n_slots,
+        "prefill_chunk": args.prefill_chunk,
+        "llm_attn_impl": getattr(engine, "attn_impl", None),
+        "slo_ttft_ms": args.slo_ttft_ms,
+        "policy": dataclasses.asdict(policy),
+        "autoscale_interval_s": args.autoscale_interval_s,
+        "sample_s": args.ramp_sample_s,
+        "phases": phase_rows,
+        "wall_s": round(total_wall, 2),
+        # Anti-flap acceptance: the recommendation may move at most
+        # (phase transitions + 2) times across the whole ramp.
+        "phase_count": len(phases),
+        "recommendation_changes": len(changes),
+        "no_flap": len(changes) <= (len(phases) - 1) + 2,
+        # Every recommendation move with its full decision record
+        # (inputs, window aggregates, rule fired, hysteresis state);
+        # unchanged evaluations re-affirm the previous recommendation.
+        "decisions": changes,
+        "evaluations_total": len(decisions),
+        # Bounded-memory accounting straight off the store: per-series
+        # point count must never exceed the configured retention.
+        "series_store": stats,
+        "series_bounded":
+            stats["points_max_per_series"] <= knobs.obs_series_points,
+        "jax_compiles_delta": int(
+            compile_watch.compiles_total() - compiles0),
+    }
+    print(json.dumps(doc), flush=True)
+    if args.json_out:
+        json.dump(doc, open(args.json_out, "w"))
 
 
 if __name__ == "__main__":

@@ -314,6 +314,53 @@ class Config:
     slo_ttft_p95_s: float = 2.0
     slo_request_p95_s: float = 5.0
 
+    # --- metric time-series store (ray_tpu/obs_series.py; the GCS folds
+    #     every metrics_push into per-key rings so the decision plane can
+    #     reason over trends, not snapshots) ---
+    # Per-series ring size: each (metric, tags, source) key keeps at most
+    # this many points — store memory is fixed at max_series × points
+    # regardless of run length.
+    obs_series_points: int = 512
+    # Points closer together than this coalesce (last write wins), so
+    # retention ≈ points × resolution seconds (~8.5 min at defaults)
+    # however fast sources flush.
+    obs_series_resolution_s: float = 1.0
+    # Hard cap on distinct series keys; past it, tombstoned series are
+    # evicted first, then the one with the stalest newest point.
+    obs_series_max_series: int = 4096
+    # How long a tombstoned series (removed replica, expired source)
+    # stays queryable for post-mortems before deletion.
+    obs_series_tombstone_ttl_s: float = 120.0
+
+    # --- serve shadow autoscaler (serve/autoscale.py) ---
+    # off | shadow | enact. shadow (default) computes and publishes
+    # replica-count recommendations (gauge + autoscale.recommend events +
+    # /api/autoscale) without ever scaling; enact additionally applies
+    # them through the existing reconcile drain/scale paths.
+    serve_autoscale_mode: str = "shadow"
+    # Evaluation cadence (each evaluation queries the series store).
+    serve_autoscale_interval_s: float = 2.0
+    # Rolling window the policy aggregates series over.
+    serve_autoscale_window_s: float = 30.0
+    # Per-replica (inflight + queued) the policy sizes capacity for
+    # (deployment autoscaling_config target_ongoing_requests overrides).
+    serve_autoscale_target_ongoing: float = 4.0
+    # TTFT-p95 target in ms; 0 = derive from slo_ttft_p95_s.
+    serve_autoscale_ttft_p95_ms: float = 0.0
+    # slo_burn_rate{slo=llm_ttft_p95} above this reads as capacity-short
+    # even when queue depth alone wouldn't scale up.
+    serve_autoscale_burn_threshold: float = 1.0
+    # Recommendation clamp (deployment autoscaling_config overrides).
+    serve_autoscale_min_replicas: int = 1
+    serve_autoscale_max_replicas: int = 8
+    # Hysteresis: the raw desire must persist this long before the
+    # recommendation moves (up fast, down slow)...
+    serve_autoscale_up_sustain_s: float = 2.0
+    serve_autoscale_down_sustain_s: float = 10.0
+    # ...and after a move, further moves wait out a cooldown.
+    serve_autoscale_up_cooldown_s: float = 5.0
+    serve_autoscale_down_cooldown_s: float = 20.0
+
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"
     # Machine-persistent root for built pip runtime envs ("" = under the

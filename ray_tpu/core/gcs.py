@@ -112,6 +112,19 @@ class GcsServer:
         # Final counter/histogram rows of expired sources (totals must
         # survive their process); stale gauges are dropped with the source.
         self.metrics_retired: list[dict] = []
+        # Rolling time-series store (obs_series.py): every metrics_push
+        # additionally lands in bounded per-(name, tags, source) rings so
+        # the decision plane (shadow autoscaler, SLO restart seeding,
+        # `status --serve --history`) can query trends via series_query.
+        # Memory is fixed: max_series × points; series of expired sources
+        # or removed replicas tombstone and are swept after the TTL.
+        from ray_tpu.obs_series import SeriesStore
+
+        self.series = SeriesStore(
+            max_points=config.obs_series_points,
+            resolution_s=config.obs_series_resolution_s,
+            max_series=config.obs_series_max_series,
+            tombstone_ttl_s=config.obs_series_tombstone_ttl_s)
         # ---- distributed ref counting (ref: reference_count.h) ----
         # Runtime state, deliberately NOT snapshotted: holders re-register
         # their full held sets on reconnect after a GCS failover.
@@ -218,6 +231,7 @@ class GcsServer:
         s.register("profile_traces", self._profile_traces)
         s.register("metrics_push", self._metrics_push)
         s.register("metrics_get", self._metrics_get)
+        s.register("series_query", self._series_query)
         s.on_disconnect(self._handle_disconnect)
 
     async def _register_node(self, conn, p):
@@ -553,6 +567,11 @@ class GcsServer:
                 for r in rows if r.get("kind") != "gauge")
             del self.metrics_by_source[source]
             self.profile_seq_by_source.pop(source, None)
+            # The source's time series go with it: tombstone now (still
+            # queryable for post-mortems), deleted after the series TTL —
+            # a churny bench's dead replicas can't grow GCS memory.
+            self.series.tombstone_source(source, now)
+        self.series.sweep(now)
         if len(self.metrics_retired) > self.MAX_RETIRED_METRIC_ROWS:
             del self.metrics_retired[
                 : len(self.metrics_retired) - self.MAX_RETIRED_METRIC_ROWS]
@@ -560,7 +579,20 @@ class GcsServer:
     async def _metrics_push(self, conn, p):
         # Latest snapshot per source process replaces the previous one.
         self.metrics_by_source[p["source"]] = (time.time(), p["rows"])
+        # ... and additionally lands in the rolling series store (full
+        # snapshot per source, so series missing from this push — e.g. a
+        # gauge the pusher dropped for a removed replica — tombstone).
+        self.series.record_rows(p["source"], p["rows"])
         return {"ok": True}
+
+    async def _series_query(self, conn, p):
+        """Windowed read of the rolling series store: name + tag-subset
+        filter, points oldest-first. The read path drives the sweeps so
+        an idle store still retires tombstoned series."""
+        self._sweep_stale_sources()
+        return self.series.query(
+            name=(p or {}).get("name"), tags=(p or {}).get("tags"),
+            window_s=(p or {}).get("window_s"))
 
     async def _metrics_get(self, conn, p):
         self._sweep_stale_sources()

@@ -122,6 +122,31 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._json({"deployments": {}})
                 return self._json({"deployments": ray_tpu.get(
                     ctrl.get_load.remote(), timeout=30)})
+            if parsed.path in ("/api/series", "/api/series/"):
+                # Rolling metric history (GCS series store): ?name=...
+                # &window_s=...&tags={"deployment":"d"} — the HTTP face
+                # of state.query_series for dashboards/scrapers.
+                q = parse_qs(parsed.query)
+                tags = None
+                if q.get("tags"):
+                    tags = json.loads(q["tags"][0])
+                window = q.get("window_s", [None])[0]
+                return self._json({"series": state.query_series(
+                    q.get("name", [None])[0], tags=tags,
+                    window_s=float(window) if window else None)})
+            if parsed.path in ("/api/autoscale", "/api/autoscale/"):
+                # Shadow-autoscaler decision plane: per-deployment
+                # recommendation + the retained decision records (inputs,
+                # window aggregates, rule fired, hysteresis state) — the
+                # post-hoc "why did it recommend that" surface.
+                from ray_tpu.serve.api import CONTROLLER_NAME
+
+                try:
+                    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+                except ValueError:
+                    return self._json({"mode": "off", "deployments": {}})
+                return self._json(ray_tpu.get(
+                    ctrl.get_autoscale.remote(), timeout=30))
             if self.path in ("/api/slo", "/api/slo/"):
                 # Rolling-window SLO status over the cluster histograms
                 # (ray_tpu/slo.py): burn rates, quantile estimates, and

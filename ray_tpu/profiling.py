@@ -198,6 +198,16 @@ class _Metric:
         with self._lock:
             return list(self._values.items())
 
+    def remove(self, tags: dict | None = None) -> bool:
+        """Drop one tagged series from this metric. The flush loop ships
+        FULL snapshots, so a removed series disappears from the next push
+        and the GCS series store tombstones its history — the controller
+        uses this to retire per-replica gauges when a replica is removed
+        (otherwise the stale tag would export its last value forever)."""
+        k = self._key(tags)
+        with self._lock:
+            return self._values.pop(k, None) is not None
+
     kind = "gauge"
 
 
@@ -252,6 +262,13 @@ class Histogram(_Metric):
         with self._lock:
             return ({k: list(v) for k, v in self._counts.items()},
                     dict(self._sums))
+
+    def remove(self, tags: dict | None = None) -> bool:
+        k = self._key(tags)
+        with self._lock:
+            self._counts.pop(k, None)
+            self._sums.pop(k, None)
+            return self._values.pop(k, None) is not None
 
 
 _registry: dict[str, _Metric] = {}

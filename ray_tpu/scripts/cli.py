@@ -134,22 +134,69 @@ def cmd_status(args) -> None:
         avail = s["resources_available"].get(k, 0)
         print(f"  {k}: {avail:g}/{s['resources_total'][k]:g} available")
     if getattr(args, "serve", False):
-        print(render_serve_status())
+        print(render_serve_status(history=getattr(args, "history", False)))
 
 
-def render_serve_status() -> str:
+def _render_history(deployment: str, window_s: float) -> list[str]:
+    """Sparkline block for one deployment from the GCS series store:
+    summed queue depth / ongoing across replicas, max TTFT EWMA, and the
+    shadow autoscaler's recommended-replica trail — metric history at a
+    glance in the terminal."""
+    from ray_tpu import state
+    from ray_tpu.obs_series import resample, sparkline
+
+    rows = (
+        ("queue_depth", "serve_replica_queue_depth", "sum"),
+        ("ongoing", "serve_replica_ongoing", "sum"),
+        ("ttft_ewma_ms", "serve_replica_ttft_ewma_ms", "max"),
+        ("kv_pages_free", "serve_replica_kv_pages_free", "sum"),
+        ("recommended_replicas",
+         "serve_autoscale_recommended_replicas", "max"),
+    )
+    out = [f"    history ({window_s:g}s):"]
+    for label, metric, agg in rows:
+        try:
+            series = state.query_series(
+                metric, tags={"deployment": deployment}, window_s=window_s)
+        except Exception as e:
+            return [f"    history unavailable ({e})"]
+        vals = resample(series, window_s, buckets=48, agg=agg)
+        if not vals:
+            continue
+        out.append(f"      {label:<22} {sparkline(vals)} "
+                   f"min={min(vals):g} max={max(vals):g} "
+                   f"last={vals[-1]:g}")
+    if len(out) == 1:
+        out.append("      (no series yet)")
+    return out
+
+
+def render_serve_status(history: bool = False,
+                        history_window_s: float = 120.0) -> str:
     """`status --serve` body: per-deployment replica counts with each
-    replica's live engine load (controller get_load) and the SLO table
-    over the cluster histograms. Factored out of cmd_status so tests can
-    assert the rendering against a live controller without re-attaching."""
+    replica's live engine load (controller get_load), the shadow
+    autoscaler's latest verdict, and the SLO table over the cluster
+    histograms; `history=True` (the --history flag) adds series-store
+    sparklines per deployment + per-SLO burn-rate trails. Factored out
+    of cmd_status so tests can assert the rendering against a live
+    controller without re-attaching."""
     import ray_tpu
     from ray_tpu import state
     from ray_tpu.serve.api import CONTROLLER_NAME
 
     lines = ["serve:"]
+    autoscale = {"mode": "off", "deployments": {}}
     try:
         ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
         load = ray_tpu.get(ctrl.get_load.remote(), timeout=30)
+        try:
+            autoscale = ray_tpu.get(ctrl.get_autoscale.remote(), timeout=30)
+        except Exception:
+            # Pre-autoscaler controller still running: load view renders.
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "controller autoscale view unavailable", exc_info=True)
     except Exception as e:
         lines.append(f"  (no serve controller: {e})")
         load = {}
@@ -168,6 +215,15 @@ def render_serve_status() -> str:
                 if key in eng:
                     bits.append(f"{key}={eng[key]}")
             lines.append(f"    replica {r['replica']}: " + " ".join(bits))
+        a = (autoscale.get("deployments") or {}).get(name)
+        if a and a.get("recommended_replicas") is not None:
+            last = (a.get("decisions") or [{}])[-1]
+            lines.append(
+                f"    autoscale[{autoscale.get('mode')}]: "
+                f"recommended={a['recommended_replicas']} "
+                f"rule={last.get('rule', '-')}")
+        if history:
+            lines.extend(_render_history(name, history_window_s))
     try:
         from ray_tpu.slo import SloMonitor
 
@@ -196,6 +252,24 @@ def render_serve_status() -> str:
                 f"{st['quantile_est_s']:.3f}s target<="
                 f"{st['threshold_s']:g}s burn={st['burn_rate']:.2f} "
                 f"[{mark}{span}]")
+    if history:
+        from ray_tpu.obs_series import resample, sparkline
+
+        try:
+            series = state.query_series("slo_burn_rate",
+                                        window_s=history_window_s)
+        except Exception as e:
+            lines.append(f"    burn history unavailable ({e})")
+            series = []
+        by_slo: dict[str, list] = {}
+        for s in series:
+            by_slo.setdefault(s["tags"].get("slo", "?"), []).append(s)
+        for slo_name in sorted(by_slo):
+            vals = resample(by_slo[slo_name], history_window_s,
+                            buckets=48, agg="max")
+            if vals:
+                lines.append(f"    burn {slo_name:<17} {sparkline(vals)} "
+                             f"max={max(vals):g} last={vals[-1]:g}")
     return "\n".join(lines)
 
 
@@ -378,6 +452,10 @@ def main(argv: list[str] | None = None) -> None:
     sp.add_argument("--serve", action="store_true",
                     help="include serve deployments with per-replica "
                          "engine load and SLO burn rates")
+    sp.add_argument("--history", action="store_true",
+                    help="with --serve: sparkline the series-store "
+                         "history (queue depth, TTFT, recommended "
+                         "replicas, SLO burn) per deployment")
     sp.set_defaults(fn=cmd_status)
 
     sp = sub.add_parser("list", help="list cluster state")

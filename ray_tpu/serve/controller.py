@@ -46,6 +46,26 @@ _DRAIN_DURATION = _profiling.Histogram(
     boundaries=_profiling.LATENCY_BUCKETS_S,
     tag_keys=("deployment",))
 
+# Per-replica load HISTORY (decision plane): each reconcile re-exports
+# the probe's engine load under deployment-tagged gauges, so the GCS
+# series store accumulates the rolling per-replica history the shadow
+# autoscaler (serve/autoscale.py), `status --serve --history`
+# sparklines, and /api/series query. Series of removed replicas are
+# remove()d here — the next metrics flush omits them, which tombstones
+# their history in the store.
+_REPLICA_LOAD_GAUGES = {
+    key: _profiling.Gauge(f"serve_replica_{key}", description=desc,
+                          tag_keys=("deployment", "replica"))
+    for key, desc in (
+        ("queue_depth", "Replica engine queue depth at the last probe"),
+        ("ongoing", "Replica inflight + queued at the last probe"),
+        ("ttft_ewma_ms", "Replica TTFT EWMA at the last probe"),
+        ("kv_pages_free", "Replica KV page-pool free at the last probe"),
+        ("prefix_cache_hit_rate",
+         "Replica prefix-cache hit rate at the last probe"),
+    )
+}
+
 # Record fields persisted across controller restarts. Runtime bookkeeping
 # (over/under_since) deliberately excluded — autoscaler timers restart clean.
 _CKPT_FIELDS = (
@@ -87,6 +107,21 @@ class ServeController:
         from ray_tpu.core.config import runtime_config
 
         self._cfg = runtime_config()
+        # Shadow autoscaler (serve/autoscale.py): observe-only replica
+        # recommendations over the series store's metric history by
+        # default; `serve_autoscale_mode=enact` applies them through the
+        # normal reconcile scale paths, `off` disables it entirely.
+        from ray_tpu.serve.autoscale import AutoscalePolicy, ShadowAutoscaler
+
+        mode = getattr(self._cfg, "serve_autoscale_mode", "shadow")
+        self._shadow = (None if mode not in ("shadow", "enact")
+                        else ShadowAutoscaler(
+                            policy=AutoscalePolicy.from_config(self._cfg),
+                            mode=mode))
+        self._autoscale_last = 0.0
+        # (deployment, replica short id) pairs with live history gauges —
+        # diffed each full reconcile so removed replicas' series retire.
+        self._load_series: set[tuple[str, str]] = set()
         self._restore()
         self._reconciler = threading.Thread(target=self._loop, daemon=True)
         self._reconciler.start()
@@ -308,6 +343,8 @@ class ServeController:
                     self._kill_replica(ent["h"])
             self._bump_version_locked()
             self._checkpoint_locked()
+        if self._shadow is not None:
+            self._shadow.forget(name)
         return True
 
     def get_routing(self, known_version: int = -1) -> dict | None:
@@ -364,6 +401,18 @@ class ServeController:
                            for ent in d.get("draining", [])))
 
     def list_deployments(self) -> dict:
+        # Shadow-autoscaler summary per deployment (full records live at
+        # get_autoscale()/ /api/autoscale): read BEFORE taking the lock —
+        # the autoscaler has its own lock and must never nest inside ours.
+        autoscale: dict[str, dict] = {}
+        if self._shadow is not None:
+            for dep, rec in self._shadow.latest().items():
+                autoscale[dep] = {
+                    "mode": self._shadow.mode,
+                    "recommended_replicas": rec["recommended_replicas"],
+                    "rule": rec["rule"],
+                    "ts": rec["ts"],
+                }
         with self._lock:
             return {
                 name: {
@@ -381,6 +430,9 @@ class ServeController:
                         aid[-8:]: s
                         for aid, s in (d.get("replica_load") or {}).items()
                     },
+                    # Last shadow-autoscaler verdict (None until the
+                    # first evaluation lands or when mode=off).
+                    "autoscale": autoscale.get(name),
                 }
                 for name, d in self.deployments.items()
             }
@@ -408,6 +460,7 @@ class ServeController:
     def shutdown(self) -> bool:
         self._stop = True
         with self._lock:
+            names = list(self.deployments)
             for d in self.deployments.values():
                 # Teardown, not scale-down: the controller is about to be
                 # killed itself, so no reaper would outlive an async
@@ -419,6 +472,9 @@ class ServeController:
             self.deployments.clear()
             self._bump_version_locked()
             self._checkpoint_locked()
+        if self._shadow is not None:
+            for name in names:
+                self._shadow.forget(name)
         return True
 
     def install_chaos(self, rules) -> bool:
@@ -594,6 +650,10 @@ class ServeController:
         Called under the lock with PRE-GATHERED stats."""
         ac = d.get("autoscaling")
         if not ac or stats is None:
+            return
+        if self._shadow is not None and self._shadow.mode == "enact":
+            # The shadow autoscaler owns scaling in enact mode — two
+            # policies adjusting num_replicas would fight each other.
             return
         total_ongoing = sum(s["inflight"] + s.get("queued", 0)
                             for s in stats)
@@ -802,6 +862,7 @@ class ServeController:
                 merged.update(
                     {aid: s for aid, s in stats if aid in live})
                 d["replica_load"] = merged
+                self._record_load_history(name, d)
                 self._autoscale_decision(d, [s for _aid, s in stats])
                 total = len(d["replicas"]) + len(d["starting"])
                 while total > d["num_replicas"]:
@@ -833,3 +894,137 @@ class ServeController:
                 if changed:
                     self._bump_version_locked()
                     self._checkpoint_locked()
+        if only is None:
+            # Full passes own the cross-deployment bookkeeping: retire
+            # history series of replicas that left, then let the shadow
+            # autoscaler evaluate (it RPCs the series store — never under
+            # the lock, never on deploy/scale-scoped passes).
+            self._retire_load_series()
+            self._run_autoscale()
+
+    # ------------------------------------------- decision-plane history
+
+    def _record_load_history(self, name: str, d: dict) -> None:
+        """Re-export this reconcile's per-replica load view as
+        deployment-tagged gauges (called under the lock; gauge sets are
+        local dict writes). The worker flush loop ships them to the GCS,
+        whose series store keeps the rolling history."""
+        for aid, _h in d["replicas"]:
+            s = d.get("replica_load", {}).get(aid)
+            if s is None:
+                continue
+            load = s.get("load") or {}
+            qd = float(load.get("queue_depth", 0.0))
+            vals = {
+                "queue_depth": qd,
+                "ongoing": float(s.get("inflight", 0.0)) + qd,
+                "ttft_ewma_ms": float(load.get("ttft_ewma_ms", 0.0)),
+                "kv_pages_free": float(load.get("pool_pages_free", 0.0)),
+                "prefix_cache_hit_rate": float(
+                    load.get("prefix_cache_hit_rate", 0.0)),
+            }
+            tags = {"deployment": name, "replica": aid[-8:]}
+            for key, gauge in _REPLICA_LOAD_GAUGES.items():
+                gauge.set(vals[key], tags=tags)
+            self._load_series.add((name, aid[-8:]))
+
+    def _retire_load_series(self) -> None:
+        """Drop history gauges of replicas (or whole deployments) no
+        longer present: the next flush omits them, so the GCS series
+        store tombstones their history instead of freezing a stale last
+        value forever."""
+        with self._lock:
+            live = {(name, aid[-8:])
+                    for name, d in self.deployments.items()
+                    for aid, _h in d["replicas"]}
+            stale = self._load_series - live
+            self._load_series &= live
+        for name, rid in stale:
+            tags = {"deployment": name, "replica": rid}
+            for gauge in _REPLICA_LOAD_GAUGES.values():
+                gauge.remove(tags=tags)
+
+    def _run_autoscale(self) -> None:
+        """Shadow-autoscaler tick (cadence-gated): evaluate every
+        deployment against the series store, publish the recommendation
+        gauge + decision record, and in `enact` mode apply it to
+        num_replicas so the normal reconcile scale paths (spawn / drain)
+        carry it out."""
+        if self._shadow is None:
+            return
+        now = time.monotonic()
+        interval = getattr(self._cfg, "serve_autoscale_interval_s", 2.0)
+        if now - self._autoscale_last < interval:
+            return
+        self._autoscale_last = now
+        with self._lock:
+            targets = [(name, d["num_replicas"], d.get("autoscaling"))
+                       for name, d in self.deployments.items()]
+        import dataclasses
+
+        for name, cur, ac in targets:
+            try:
+                policy = self._shadow.policy
+                if ac:
+                    # A deployment's own autoscaling_config wins for
+                    # bounds and target load; the policy's windows/
+                    # hysteresis stay. Inside the try: an inconsistent
+                    # config (min > max) must fail THIS deployment's
+                    # evaluation, not abort the rest each tick.
+                    policy = dataclasses.replace(
+                        policy,
+                        min_replicas=int(ac["min_replicas"]),
+                        max_replicas=max(1, int(ac["max_replicas"])),
+                        target_ongoing=float(ac.get(
+                            "target_ongoing_requests",
+                            policy.target_ongoing)))
+                record = self._shadow.evaluate(name, cur, policy=policy)
+            except Exception:
+                # One deployment's bad evaluation must not silence the
+                # rest (or the reconcile loop hosting this).
+                logger.exception("shadow autoscale failed for %s", name)
+                continue
+            if self._shadow.mode != "enact" or not record["changed"]:
+                continue
+            rec = record["recommended_replicas"]
+            with self._lock:
+                d = self.deployments.get(name)
+                if rec < 1 and d is not None:
+                    # Scale-to-zero gate (mirrors _autoscale_decision): a
+                    # recent handle-side wake-up means a request is still
+                    # landing — enacting 0 now would kill the replica it
+                    # is waiting on.
+                    grace = getattr(self._cfg,
+                                    "serve_cold_start_grace_s", 10.0)
+                    cold = d.get("cold_ts")
+                    if cold is not None and \
+                            time.monotonic() - cold < grace:
+                        continue
+                if d is not None and d["num_replicas"] != rec:
+                    logger.info("autoscale enact: %s %d -> %d (%s)",
+                                name, d["num_replicas"], rec,
+                                record["rule"])
+                    d["num_replicas"] = rec
+                    d["over_since"] = None
+                    d["under_since"] = None
+                    self._checkpoint_locked()
+
+    def get_autoscale(self) -> dict:
+        """Decision-plane read model (dashboard /api/autoscale): mode +
+        per-deployment current/recommended replicas and the retained
+        decision records (oldest → newest), each carrying its inputs,
+        window aggregates, rule fired, and hysteresis state."""
+        mode = "off" if self._shadow is None else self._shadow.mode
+        out: dict = {"mode": mode, "deployments": {}}
+        if self._shadow is None:
+            return out
+        with self._lock:
+            targets = [(name, d["num_replicas"])
+                       for name, d in self.deployments.items()]
+        for name, cur in targets:
+            out["deployments"][name] = {
+                "current_replicas": cur,
+                "recommended_replicas": self._shadow.recommended(name),
+                "decisions": self._shadow.decisions(name),
+            }
+        return out

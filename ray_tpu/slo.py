@@ -31,10 +31,13 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
 import threading
 import time
 
 from ray_tpu import profiling as _profiling
+
+logger = logging.getLogger(__name__)
 
 _BURN_RATE = _profiling.Gauge(
     "slo_burn_rate",
@@ -81,14 +84,28 @@ class SloMonitor:
     `export=False` makes the monitor passive: no `slo_burn_rate` gauges,
     no `slo.violation` cluster events — for one-shot readers (the CLI)
     whose first evaluation is lifetime totals, not a rolling window; a
-    read-only command must not file alarms or overwrite live gauges."""
+    read-only command must not file alarms or overwrite live gauges.
+
+    Cold start: a fresh monitor (controller/dashboard restart) seeds its
+    rolling window from the GCS series store — the cumulative histogram
+    snapshot ~window_s ago becomes the baseline, so the first evaluation
+    is already windowed and alarms re-arm immediately instead of waiting
+    out a second poll. `seed=False` (or no history: empty store,
+    clusterless process) falls back to the lifetime-first behavior.
+    `history_fn(metric, tags, window_s) -> series rows` injects a store
+    for tests / the ramp bench; default is state.query_series, guarded
+    so seeding never auto-starts a cluster."""
 
     def __init__(self, objectives: list[Objective] | None = None,
-                 rows_fn=None, export: bool = True):
+                 rows_fn=None, export: bool = True, seed: bool = True,
+                 history_fn=None):
         self.objectives = (list(objectives) if objectives is not None
                            else default_objectives())
         self._rows_fn = rows_fn
         self._export = export
+        self._seed = seed
+        self._history_fn = history_fn
+        self._seed_attempted: set[str] = set()
         # objective name → deque[(monotonic ts, per-bucket counts)]
         self._snaps: dict[str, collections.deque] = {
             o.name: collections.deque() for o in self.objectives}
@@ -160,6 +177,9 @@ class SloMonitor:
                     "burn_rate": 0.0, "violating": False}
         boundaries, cur = merged
         ring = self._snaps[obj.name]
+        if (not ring and self._seed
+                and obj.name not in self._seed_attempted):
+            self._try_seed(obj, boundaries, now)
         ring.append((now, cur))
         # Keep the newest snapshot at least window_s old as the baseline;
         # drop anything older. A single-snapshot ring (first evaluation)
@@ -224,6 +244,75 @@ class SloMonitor:
                     severity="WARNING", source="slo", **ev)
         self._violating[obj.name] = violating
         return status
+
+    def _try_seed(self, obj: Objective, boundaries, now: float) -> None:
+        """Cold-start baseline from the series store: per matching
+        histogram series, take the newest point at least window_s old
+        (else its earliest point — a partial window, exactly what a
+        continuously-running monitor would hold mid-warmup), sum the
+        bucket vectors, and plant the result in the ring at its true
+        age. One attempt per objective; any failure = no history =
+        current (lifetime-first) behavior."""
+        self._seed_attempted.add(obj.name)
+        try:
+            if self._history_fn is not None:
+                series = self._history_fn(obj.metric, dict(obj.tags),
+                                          obj.window_s * 2)
+            else:
+                import os
+
+                from ray_tpu import api as _api
+                from ray_tpu import state as _state
+
+                # Same attach contract as emit_cluster_event: a seeding
+                # read must never auto-START a cluster.
+                if _api._client is None and not (
+                        os.environ.get("RAY_TPU_GCS_ADDRESS")
+                        and os.environ.get("RAY_TPU_RAYLET_ADDRESS")):
+                    return
+                series = _state.query_series(
+                    obj.metric, tags=dict(obj.tags) or None,
+                    window_s=obj.window_s * 2)
+        except Exception as e:
+            logger.debug("slo %s: history seed unavailable: %s",
+                         obj.name, e)
+            return
+        wall = time.time()
+        target = wall - obj.window_s
+        n = len(boundaries) + 1
+        chosen: list[tuple[float, list]] = []
+        for s in series:
+            if s.get("kind") != "histogram":
+                continue
+            if tuple(s.get("boundaries") or ()) != tuple(boundaries):
+                continue
+            pts = [(ts, v) for ts, v in (s.get("points") or ())
+                   if isinstance(v, (list, tuple)) and len(v) == n]
+            if not pts:
+                continue
+            if s.get("tombstoned"):
+                # A dead source's series no longer grows, but its FINAL
+                # counts live on in the hub's retired rows (part of the
+                # current merged snapshot forever). Baseline at its
+                # newest point so it cancels out of the window delta —
+                # baselining it window_s ago would book the dead
+                # source's tail as fresh traffic on every restart.
+                chosen.append(pts[-1])
+                continue
+            best = None
+            for ts, v in pts:
+                if ts <= target or best is None:
+                    best = (ts, v)
+                if ts > target:
+                    break
+            chosen.append(best)
+        if not chosen:
+            return
+        agg = [float(sum(vs)) for vs in zip(*(v for _ts, v in chosen))]
+        age = wall - min(ts for ts, _v in chosen)
+        self._snaps[obj.name].append((now - age, agg))
+        logger.debug("slo %s: seeded %.1fs-old baseline from the series "
+                     "store (%d series)", obj.name, age, len(chosen))
 
     def _set_burn(self, name: str, burn: float) -> None:
         if self._export:

@@ -260,6 +260,26 @@ def prometheus_metrics() -> str:
     return profiling.prometheus_text(metrics_rows())
 
 
+def query_series(name: str | None = None, tags: dict | None = None,
+                 window_s: float | None = None) -> list[dict]:
+    """Rolling metric history from the GCS series store (obs_series.py):
+    one row per matching (name, tags, source) series with its in-window
+    points oldest-first — {"name", "tags", "source", "kind", "points":
+    [[ts, value], ...], "tombstoned"} (histogram series carry their
+    per-bucket count vectors + "boundaries"). `tags` subset-filters;
+    `window_s=None` returns full retention. This is the read path the
+    shadow autoscaler, SLO restart seeding, and `status --serve
+    --history` sparklines share."""
+    payload: dict = {}
+    if name is not None:
+        payload["name"] = name
+    if tags:
+        payload["tags"] = {str(k): str(v) for k, v in tags.items()}
+    if window_s is not None:
+        payload["window_s"] = float(window_s)
+    return list(_call_gcs("series_query", payload) or [])
+
+
 def _call_raylet_addr(address, method: str, payload: dict) -> Any:
     async def go():
         conn = await rpc.connect(*tuple(address), timeout=5.0)
