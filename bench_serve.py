@@ -110,6 +110,39 @@ def main() -> None:
     ap.add_argument("--slo-ttft-ms", type=float, default=1000.0,
                     help="TTFT p95 SLO target driving the burn-rate"
                          " signal during --ramp")
+    ap.add_argument("--real-replicas", type=int, default=0,
+                    help="closed-loop mode against a REAL deployed"
+                         " cluster: deploy this many LLMDeployment"
+                         " replicas, drive the ramp through the async"
+                         " HTTP proxy as SSE streams (token-exact vs an"
+                         " uninterrupted baseline), and let the"
+                         " controller's autoscaler (--autoscale-mode)"
+                         " drive the actual replica count. 0 = the"
+                         " legacy in-process engine modes")
+    ap.add_argument("--router", default="p2c_load",
+                    choices=("p2c_local", "p2c_load", "affinity"),
+                    help="serve_router_policy for the real-replica run:"
+                         " legacy local p2c | blended load p2c |"
+                         " prefix-affine with load spill")
+    ap.add_argument("--autoscale-mode", default="enact",
+                    choices=("off", "shadow", "enact"),
+                    help="controller autoscaler mode (--real-replicas)")
+    ap.add_argument("--chaos-kill-at", type=float, default=0.0,
+                    help="seconds into the real-replica run at which a"
+                         " routable replica gets a seeded decode-window"
+                         " SIGKILL (0 = no chaos)")
+    ap.add_argument("--overload-queue-depth", type=int, default=0,
+                    help="serve_overload_queue_depth for the real run"
+                         " (0 disables proxy overload shedding)")
+    ap.add_argument("--spill-ongoing", type=float, default=None,
+                    help="serve_router_spill_ongoing override for the"
+                         " real run (affinity spill threshold)")
+    ap.add_argument("--drain-timeout", type=float, default=20.0,
+                    help="serve_drain_timeout_s for the real run")
+    ap.add_argument("--prompt-pool-size", type=int, default=16,
+                    help="distinct prompts the real-replica clients"
+                         " rotate through (exactness baselines are"
+                         " precomputed per pool member)")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if not 0.0 <= args.shared_prefix_frac <= 1.0:
@@ -132,6 +165,12 @@ def main() -> None:
             ap.error("--ramp must be 'clients:seconds,...' phases")
         if not phases or any(c < 1 or s <= 0 for c, s in phases):
             ap.error("--ramp phases need clients >= 1 and seconds > 0")
+
+    if args.real_replicas:
+        if phases is None:
+            phases = [(args.clients, 30.0)]
+        _run_real(args, phases)
+        return
 
     if args.model == "tiny":
         # CI path: force the CPU backend before jax initializes.
@@ -355,6 +394,342 @@ def main() -> None:
     print(json.dumps(row), flush=True)
     if args.json_out:
         json.dump(row, open(args.json_out, "w"))
+
+
+def _run_real(args, phases) -> None:
+    """Closed-loop ramp against REAL replicas: deploy LLMDeployment,
+    drive timed phases of SSE clients through the async HTTP proxy, and
+    let the controller's autoscaler (shadow or ENACT) move the actual
+    replica count while the bench records the recommended-vs-actual
+    trajectory, client TTFT, shed/failover/drain counters, per-replica
+    prefix-cache hit rates, and token EXACTNESS of every stream against
+    an uninterrupted in-process baseline (the PR 9 zero-drop bar — a
+    seeded mid-ramp SIGKILL must cost zero dropped or duplicated
+    tokens). The in-process --ramp mode is this loop's dry run; this is
+    the closed loop itself."""
+    import bench_chaos
+
+    from ray_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(1)
+
+    import ray_tpu
+    from ray_tpu import serve, state
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.api import _get_controller
+    from ray_tpu.serve.llm import LLMDeployment, LLMEngine
+
+    cfg = gpt.GPTConfig.by_name(args.model)
+    rng = np.random.default_rng(0)
+    # Deterministic prompt pool: a fraction of each prompt comes from a
+    # small shared-prefix pool (the affinity workload), the rest is a
+    # fixed unique suffix — baselines are precomputed per pool member so
+    # every completed stream is checked token-exact.
+    shared_len = int(round(args.shared_prefix_frac * args.prompt_len))
+    prefixes = [list(map(int, rng.integers(0, cfg.vocab_size, shared_len)))
+                for _ in range(args.prefix_pool)] if shared_len else []
+    pool = []
+    for i in range(max(1, args.prompt_pool_size)):
+        uniq = list(map(int, rng.integers(
+            0, cfg.vocab_size, args.prompt_len - shared_len)))
+        pool.append((prefixes[i % len(prefixes)] if prefixes else [])
+                    + uniq)
+
+    engine_kwargs: dict = {"decode_block": args.decode_block,
+                           "kv_mode": args.kv_mode,
+                           "page_size": args.page_size}
+    if args.n_pages is not None:
+        engine_kwargs["n_pages"] = args.n_pages
+    if args.attn_impl is not None:
+        engine_kwargs["attn_impl"] = args.attn_impl
+    if args.prefill_chunk:
+        engine_kwargs["prefill_chunk"] = args.prefill_chunk
+        engine_kwargs["prefill_token_budget"] = (
+            args.prefill_budget if args.prefill_budget is not None
+            else args.n_slots * args.prefill_chunk)
+    if args.prefix_cache:
+        engine_kwargs["prefix_cache"] = True
+        if args.prefix_cache_pages is not None:
+            engine_kwargs["prefix_cache_pages"] = args.prefix_cache_pages
+
+    # Uninterrupted greedy baseline (same params seed the replicas use).
+    base = LLMEngine(cfg, None, n_slots=args.n_slots, max_len=args.max_len,
+                     **engine_kwargs)
+    expected = []
+    for p in pool:
+        req = base.submit(p, max_tokens=args.max_tokens)
+        while not req.done.is_set():
+            base.step()
+        expected.append(list(req.out_ids))
+
+    sys_cfg = {
+        "serve_autoscale_mode": args.autoscale_mode,
+        "serve_autoscale_interval_s": args.autoscale_interval_s,
+        "serve_autoscale_window_s": args.autoscale_window_s,
+        "serve_autoscale_up_sustain_s": 1.0,
+        "serve_autoscale_down_sustain_s": 5.0,
+        "serve_autoscale_up_cooldown_s": 2.0,
+        "serve_autoscale_down_cooldown_s": 6.0,
+        "serve_router_policy": args.router,
+        "llm_prefill_chunk": args.prefill_chunk,
+        "serve_drain_timeout_s": args.drain_timeout,
+        "serve_overload_queue_depth": args.overload_queue_depth,
+        "worker_profile_flush_interval_s": 0.5,
+    }
+    if args.spill_ongoing is not None:
+        sys_cfg["serve_router_spill_ongoing"] = args.spill_ongoing
+    ray_tpu.init(num_cpus=args.max_replicas + 3, _system_config=sys_cfg)
+    t_start = time.perf_counter()
+    events: list = []
+    try:
+        target = (args.target_ongoing if args.target_ongoing
+                  else float(args.n_slots))
+        dep = serve.deployment(LLMDeployment, name="bench").options(
+            num_replicas=args.real_replicas, route_prefix="/bench",
+            # mode=off pins the replica count (router/cache ablations
+            # need a FIXED denominator — any autoscaling_config would
+            # also arm the legacy reactive policy).
+            autoscaling_config=None if args.autoscale_mode == "off" else {
+                "min_replicas": 1, "max_replicas": args.max_replicas,
+                "target_ongoing_requests": target,
+            }).bind(args.model, n_slots=args.n_slots,
+                    max_len=args.max_len, jax_platform="cpu",
+                    engine_kwargs=engine_kwargs)
+        handle = serve.run(dep, timeout=600.0)
+        _proxy, port = serve.start_proxy()
+        # Warm EVERY initial replica's compile cache at the REAL output
+        # length (a width the warmup never visited would compile
+        # mid-measurement): dispatch directly per routable replica —
+        # routing the warmups through the load-balanced handle can
+        # leave a replica cold by chance.
+        ctrl = _get_controller()
+        table = ray_tpu.get(ctrl.get_routing.remote(-1), timeout=60)
+        for replica in table["routes"]["bench"]["replicas"]:
+            ray_tpu.get(replica.handle_request.remote(
+                "generate", (pool[0],),
+                {"max_tokens": args.max_tokens}), timeout=600)
+        bench_chaos._sse_stream(port, "/bench", {
+            "prompt_ids": pool[0], "max_tokens": 2}, timeout_s=120)
+
+        def counter_total(name: str) -> float:
+            try:
+                return sum(r.get("value", 0.0)
+                           for r in state.metrics_rows()
+                           if r.get("name") == name)
+            except Exception:  # noqa: BLE001 — metrics hub unreachable
+                return 0.0
+
+        time.sleep(1.0)     # let warmup metrics flush before baselining
+        c0 = {name: counter_total(name) for name in (
+            "serve_requests_shed_total", "serve_failovers_total",
+            "serve_drain_total")}
+
+        stop = threading.Event()
+        traj: list = []
+
+        def sampler():
+            while not stop.is_set():
+                try:
+                    st = serve.status().get("bench")
+                except Exception:  # noqa: BLE001 — controller mid-restart
+                    st = None
+                if st:
+                    au = st.get("autoscale") or {}
+                    traj.append({
+                        "t": round(time.perf_counter() - t_start, 2),
+                        "recommended": au.get("recommended_replicas"),
+                        "num_replicas": st["num_replicas"],
+                        "live": st["live_replicas"],
+                        "starting": st["starting_replicas"],
+                        "draining": st["draining_replicas"],
+                    })
+                stop.wait(0.5)
+
+        sampler_t = threading.Thread(target=sampler, daemon=True)
+        sampler_t.start()
+
+        if args.chaos_kill_at > 0:
+            def chaos_killer():
+                time.sleep(args.chaos_kill_at)
+                try:
+                    ctrl = _get_controller()
+                    table = ray_tpu.get(ctrl.get_routing.remote(-1),
+                                        timeout=30)
+                    reps = table["routes"]["bench"]["replicas"]
+                    if reps:
+                        ray_tpu.get(reps[-1].install_chaos.remote(
+                            [{"site": "llm.decode_window",
+                              "action": "kill", "after": 2}]), timeout=30)
+                        events.append({
+                            "t": round(time.perf_counter() - t_start, 2),
+                            "event": "chaos_sigkill_armed"})
+                except Exception as e:  # noqa: BLE001
+                    events.append({"event": f"chaos arm failed: {e!r}"})
+
+            threading.Thread(target=chaos_killer, daemon=True).start()
+
+        phase_rows = []
+        totals = {"completed": 0, "dropped": 0, "mismatched": 0,
+                  "shed": 0}
+        for pi, (clients, dur) in enumerate(phases):
+            deadline = time.perf_counter() + dur
+            rec = {"completed": 0, "dropped": 0, "mismatched": 0,
+                   "shed": 0, "ttfts": [], "tok_s": [], "errs": []}
+            plock = threading.Lock()
+
+            def client(tid: int, deadline=deadline, rec=rec, plock=plock):
+                it = 0
+                while time.perf_counter() < deadline:
+                    idx = (tid + it * 13) % len(pool)
+                    it += 1
+                    t0 = time.perf_counter()
+                    r = bench_chaos._sse_stream(port, "/bench", {
+                        "prompt_ids": pool[idx],
+                        "max_tokens": args.max_tokens}, timeout_s=300)
+                    with plock:
+                        if r["error"] and "overloaded" in str(r["error"]):
+                            rec["shed"] += 1
+                        elif r["error"] or not r["done"]:
+                            rec["dropped"] += 1
+                            if len(rec["errs"]) < 5:
+                                rec["errs"].append(str(r["error"])[:160])
+                        else:
+                            rec["completed"] += 1
+                            if r["tokens"] != expected[idx]:
+                                rec["mismatched"] += 1
+                            a = r["arrivals"]
+                            if a:
+                                rec["ttfts"].append(a[0] - t0)
+                            if len(a) > 1 and a[-1] > a[0]:
+                                rec["tok_s"].append(
+                                    (len(a) - 1) / (a[-1] - a[0]))
+                    if r["error"] and "overloaded" in str(r["error"]):
+                        time.sleep(0.5)     # honor the shed backoff
+
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            ttfts = sorted(rec["ttfts"])
+            toks = sorted(rec["tok_s"])
+            tail = traj[-1] if traj else {}
+            row = {
+                "phase": pi, "clients": clients, "duration_s": dur,
+                "wall_s": round(wall, 2),
+                "completed": rec["completed"],
+                "dropped": rec["dropped"],
+                "mismatched": rec["mismatched"],
+                "shed": rec["shed"],
+                "req_per_s": round(rec["completed"] / wall, 2),
+                "recommended_replicas": tail.get("recommended"),
+                "live_replicas": tail.get("live"),
+            }
+            if rec["errs"]:
+                row["errors_sample"] = rec["errs"]
+            if ttfts:
+                row["ttft_p50_ms"] = round(
+                    ttfts[len(ttfts) // 2] * 1000, 1)
+                row["ttft_p95_ms"] = round(
+                    ttfts[int(len(ttfts) * 0.95)] * 1000, 1)
+            if toks:
+                # Per-stream decode rate (client-observed): the shed
+                # acceptance pins its p95 within 15% of unloaded.
+                row["stream_tok_s_p50"] = round(
+                    toks[len(toks) // 2], 2)
+                row["stream_tok_s_p05"] = round(
+                    toks[int(len(toks) * 0.05)], 2)
+            for k in totals:
+                totals[k] += rec[k]
+            phase_rows.append(row)
+        stop.set()
+        sampler_t.join(timeout=10)
+
+        # Same settle as before the c0 baseline: counters reach the hub
+        # on the flush cadence — a shed/failover/drain in the final
+        # window must not be missed by an instant read.
+        time.sleep(1.0)
+        c1 = {name: counter_total(name) for name in c0}
+        # Final per-replica cache view (affinity evidence).
+        hit_rates: list = []
+        per_hits: list = []
+        per_misses: list = []
+        agg_hits = agg_misses = 0
+        try:
+            ctrl = _get_controller()
+            load = ray_tpu.get(ctrl.get_load.remote(), timeout=30)
+            for r in load.get("bench", {}).get("replicas", []):
+                eng = r.get("load") or {}
+                if "prefix_cache_hit_rate" in eng:
+                    hit_rates.append(eng["prefix_cache_hit_rate"])
+                per_hits.append(int(eng.get("prefix_cache_hits", 0)))
+                per_misses.append(int(eng.get("prefix_cache_misses", 0)))
+                agg_hits += int(eng.get("prefix_cache_hits", 0))
+                agg_misses += int(eng.get("prefix_cache_misses", 0))
+        except Exception as e:  # noqa: BLE001
+            events.append({"event": f"final load read failed: {e!r}"})
+
+        recs = [s["recommended"] for s in traj
+                if s["recommended"] is not None]
+        lives = [s["live"] for s in traj]
+        doc = {
+            "metric": "serve_llm_real_ramp",
+            "model": args.model, "kv_mode": args.kv_mode,
+            "n_slots": args.n_slots,
+            "prefill_chunk": args.prefill_chunk,
+            "prefix_cache": bool(args.prefix_cache),
+            "shared_prefix_frac": args.shared_prefix_frac,
+            "prefix_pool": args.prefix_pool if shared_len else 0,
+            "prompt_pool_size": len(pool),
+            "router": args.router,
+            "autoscale_mode": args.autoscale_mode,
+            "real_replicas_initial": args.real_replicas,
+            "max_replicas": args.max_replicas,
+            "target_ongoing": target,
+            "slo_ttft_ms": args.slo_ttft_ms,
+            "chaos_kill_at_s": args.chaos_kill_at,
+            "overload_queue_depth": args.overload_queue_depth,
+            "phases": phase_rows,
+            **totals,
+            "shed_counter_delta": round(
+                c1["serve_requests_shed_total"]
+                - c0["serve_requests_shed_total"], 1),
+            "failovers_delta": round(
+                c1["serve_failovers_total"]
+                - c0["serve_failovers_total"], 1),
+            "drains_delta": round(
+                c1["serve_drain_total"] - c0["serve_drain_total"], 1),
+            "per_replica_hit_rate": hit_rates,
+            # Admission counts per replica: the spill/pileup evidence —
+            # under affinity BOTH replicas must keep serving (spill),
+            # and the hit/miss split shows whose cache was warm.
+            "per_replica_hits": per_hits,
+            "per_replica_misses": per_misses,
+            "aggregate_hit_rate": (
+                round(agg_hits / (agg_hits + agg_misses), 4)
+                if agg_hits + agg_misses else None),
+            "recommended_vs_actual": {
+                "recommended_max": max(recs) if recs else None,
+                "live_max": max(lives) if lives else None,
+                "recommended_final": recs[-1] if recs else None,
+                "live_final": lives[-1] if lives else None,
+                "tracked_up": bool(recs and max(lives) >= max(recs)),
+                "tracked_down": bool(recs and lives
+                                     and lives[-1] == recs[-1]),
+            },
+            "trajectory": traj,
+            "events": events,
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        }
+        print(json.dumps(doc), flush=True)
+        if args.json_out:
+            json.dump(doc, open(args.json_out, "w"))
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
 
 
 def _run_ramp(args, phases, engine, cfg, compiles0) -> None:

@@ -53,6 +53,7 @@ case "$TIER" in
       tests/test_graftlint_v2.py      # flow-aware families + compat shim
       tests/test_flight_recorder.py   # compile watch / load / SLO
       tests/test_autoscale.py         # series store + shadow autoscaler
+      tests/test_router.py            # load/affinity routing + shedding
       tests/test_chaos.py             # drain/failover + chaos harness
     ) ;;
   *) echo "usage: $0 [fast|full|quick]" >&2; exit 2 ;;
@@ -69,7 +70,8 @@ for guarded in tests/test_tracing.py tests/test_paged_attention.py \
                tests/test_chunked_prefill.py tests/test_prefix_cache.py \
                tests/test_graftlint.py \
                tests/test_graftlint_v2.py tests/test_flight_recorder.py \
-               tests/test_autoscale.py tests/test_chaos.py; do
+               tests/test_autoscale.py tests/test_router.py \
+               tests/test_chaos.py; do
   collected=$(python -m pytest "${guarded}" --collect-only -q \
     -p no:cacheprovider 2>/dev/null | grep -c "^${guarded}" || true)
   if [ "${collected}" -eq 0 ]; then

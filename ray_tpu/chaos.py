@@ -44,6 +44,15 @@ Wired sites (kept in SITES so tests can assert coverage):
                                  (kill-controller-mid-reconcile)
     serve.controller.ckpt_write  controller checkpoint KV write
                                  (raise → transient GCS write failure)
+    serve.controller.enact       autoscale enactment, AFTER the decision
+                                 record is retained but BEFORE the scale
+                                 applies to num_replicas (kill -9 → the
+                                 restarted controller must re-derive the
+                                 recommendation, never double-apply)
+    serve.routes.push            controller routing-table push publish
+                                 (drop → handles/proxies must keep
+                                 serving from their cached table and
+                                 converge via the TTL refresh)
 """
 
 from __future__ import annotations
@@ -65,6 +74,8 @@ SITES = (
     "serve.replica.probe",
     "serve.controller.reconcile",
     "serve.controller.ckpt_write",
+    "serve.controller.enact",
+    "serve.routes.push",
 )
 
 _ACTIONS = ("kill", "raise", "drop", "delay")

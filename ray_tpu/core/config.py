@@ -251,6 +251,40 @@ class Config:
     serve_ckpt_write_retries: int = 4
     serve_ckpt_write_backoff_s: float = 0.2
 
+    # --- serve router (load-aware + prefix-affine replica selection) ---
+    # How handles/proxies pick a replica per request:
+    #   p2c_local  power-of-two-choices on the handle's OWN outstanding
+    #              counts only — byte-for-byte the legacy router.
+    #   p2c_load   (default) power-of-two-choices on a BLENDED score:
+    #              handle-local inflight + the replica's last-probed
+    #              ongoing (inflight + queued), staleness-decayed. The
+    #              controller pushes the per-replica load table to
+    #              handles alongside the routing table on every
+    #              reconcile, so the signal is cluster-wide, not
+    #              handle-local.
+    #   affinity   p2c_load plus prefix-affine placement: requests
+    #              whose prompt hashes to a warm replica (rendezvous
+    #              hash over the chunk-chain head) route there unless
+    #              its blended load crosses the spill threshold.
+    serve_router_policy: str = "p2c_load"
+    # Probed-load staleness horizon: a probe older than this contributes
+    # nothing to the blended score (linear decay in between), so a
+    # lagging probe can never blackhole traffic onto one replica.
+    serve_router_load_stale_s: float = 5.0
+    # Affinity spill threshold: when the preferred (prefix-affine)
+    # replica's blended load reaches this many ongoing requests, the
+    # request spills to the load-balanced pick instead — affinity must
+    # never defeat load balancing.
+    serve_router_spill_ongoing: float = 16.0
+    # --- overload shedding (proxy admission, per deployment) ---
+    # When the autoscaler's recommendation is pinned at max_replicas and
+    # every replica's last-probed queue depth exceeds this, the proxy
+    # sheds new requests with a typed 503 + Retry-After instead of
+    # letting TTFT burn unboundedly. 0 disables shedding.
+    serve_overload_queue_depth: int = 32
+    # Retry-After value handed to shed clients.
+    serve_overload_retry_after_s: float = 1.0
+
     # --- LLM serving engine ---
     # Fused decode window: tokens generated per device dispatch with
     # on-device sampling. The dominant knob when dispatch latency is
@@ -360,6 +394,11 @@ class Config:
     # ...and after a move, further moves wait out a cooldown.
     serve_autoscale_up_cooldown_s: float = 5.0
     serve_autoscale_down_cooldown_s: float = 20.0
+    # Enact-mode blast-radius guard: one enactment may change
+    # num_replicas by at most this many replicas — one bad decision
+    # window can't mass-kill (or mass-spawn) a fleet; convergence to a
+    # far-away recommendation takes multiple cooldown-spaced steps.
+    serve_autoscale_max_enact_step: int = 8
 
     # --- paths ---
     session_dir: str = "/tmp/ray_tpu"

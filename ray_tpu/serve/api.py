@@ -26,6 +26,69 @@ _local = threading.local()
 # client was re-initialized.
 _push_state = {"version": -1, "client": None}
 
+# Process-level dead-actor set fed by the GCS actor-death pubsub (plus
+# note_dead() from failover paths that just watched a replica die): the
+# hot routing path filters corpses with O(1) set lookups instead of one
+# client actor_state lookup per cached replica per pick. Bounded: serve
+# replicas never restart in place (the controller spawns replacements
+# under fresh ids), so entries only matter while a stale route cache
+# still lists the corpse — old ids age out at the cap.
+_dead_state: dict = {"client": None, "dead": None}
+_DEAD_CAP = 4096
+
+
+def _dead_actors():
+    """The process's dead-replica id set (bytes actor ids), arming the
+    actor-death subscription on first use / client re-init."""
+    import collections
+
+    from ray_tpu import api as _api
+
+    client = _api._ensure_client()
+    if _dead_state["client"] is not client:
+        _dead_state["client"] = client
+        _dead_state["dead"] = collections.OrderedDict()
+
+        def on_actor(payload, _c=client):
+            if _dead_state["client"] is not _c:
+                return
+            if payload.get("state") == "DEAD":
+                d = _dead_state["dead"]
+                d[payload.get("actor_id")] = True
+                while len(d) > _DEAD_CAP:
+                    d.popitem(last=False)
+
+        try:
+            client.subscribe_channel("actor", on_actor)
+        except Exception as e:
+            # Without the death feed the TTL refresh + failover retries
+            # still bound how long a corpse can be picked; say so once.
+            logger.debug("actor-death subscription failed (dead replicas "
+                         "age out via TTL refresh only): %s", e)
+    return _dead_state["dead"]
+
+
+def note_dead(actor_id: bytes) -> None:
+    """Record an observed corpse ahead of the pubsub notification (the
+    failover paths call this the moment a dispatch dies), so the very
+    next pick — possibly before the GCS broadcast lands — already
+    filters it."""
+    d = _dead_state["dead"]
+    if d is not None:
+        d[actor_id] = True
+        while len(d) > _DEAD_CAP:
+            d.popitem(last=False)
+
+
+def _rendezvous(key: bytes, replicas: list):
+    """Highest-random-weight (rendezvous) hash: the stable preferred
+    replica for an affinity key — stable under membership churn (only
+    keys owned by a removed replica move)."""
+    import hashlib
+
+    return max(replicas, key=lambda r: hashlib.blake2b(
+        key + r._actor_id.binary(), digest_size=8).digest())
+
 
 def _pushed_version() -> int:
     from ray_tpu import api as _api
@@ -48,6 +111,10 @@ def _pushed_version() -> int:
             # polling — correct but slower to see redeploys; say so once.
             logger.debug("routes push subscription failed (handles will "
                          "poll): %s", e)
+        try:
+            _dead_actors()  # death feed rides the same (re)arm point
+        except Exception as e:
+            logger.debug("actor-death subscription arm failed: %s", e)
     return _push_state["version"]
 
 
@@ -156,9 +223,41 @@ class DeploymentHandle:
         _cfg = runtime_config()
         self.REFRESH_TTL_S = _cfg.serve_handle_refresh_ttl_s
         self.COLD_START_TIMEOUT_S = _cfg.serve_cold_start_timeout_s
+        # Router policy (serve_router_policy): p2c_local = legacy
+        # handle-local power-of-two-choices; p2c_load = p2c over blended
+        # local + probed load; affinity = p2c_load + prefix-affine
+        # placement with load spill.
+        self._policy = getattr(_cfg, "serve_router_policy", "p2c_load")
+        if self._policy not in ("p2c_local", "p2c_load", "affinity"):
+            logger.warning("unknown serve_router_policy %r; using "
+                           "p2c_load", self._policy)
+            self._policy = "p2c_load"
+        self._load_stale_s = max(
+            0.001, getattr(_cfg, "serve_router_load_stale_s", 5.0))
+        self._spill_ongoing = getattr(
+            _cfg, "serve_router_spill_ongoing", 16.0)
+        self._shed_queue_depth = int(getattr(
+            _cfg, "serve_overload_queue_depth", 0))
+        self._shed_retry_after_s = getattr(
+            _cfg, "serve_overload_retry_after_s", 1.0)
+        # Affinity keys hash the chunk-chain head at the engine's prefill
+        # chunk granularity (so keys match the prefix cache's depth-1
+        # entries); a one-shot engine (chunk 0) falls back to 64.
+        self._affinity_chunk = int(
+            getattr(_cfg, "llm_prefill_chunk", 0) or 64)
         self.deployment_name = deployment_name
         self._version = -1
         self._replicas: list = []
+        # actor id hex → last-probed load row (pushed by the controller
+        # alongside the routing table: queue_depth / ongoing /
+        # ttft_ewma_ms / kv_pages_free / prefix_cache_hit_rate / ts).
+        self._loads: dict[str, dict] = {}
+        # (table build ts on the controller's clock, local monotonic at
+        # receipt): probe ages are computed as same-clock differences —
+        # see _row_age. None = no table yet (unit use falls back to a
+        # local wall-clock diff).
+        self._loads_ref: tuple[float, float] | None = None
+        self._overload_pinned = False
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         # Router-local in-flight per replica (actor id → count): the
@@ -167,11 +266,20 @@ class DeploymentHandle:
         # reference router's RunningReplica queue-len cache,
         # serve/_private/replica_scheduler/pow_2_scheduler.py).
         self._local_inflight: dict[bytes, int] = {}
-        try:
-            _pushed_version()  # arm the process-level push subscription
-        except Exception as e:
-            logger.debug("push subscription arm failed (handle will "
-                         "poll): %s", e)
+        # Arm the process-level push subscription + actor-death feed —
+        # only when a client already exists: constructing a handle must
+        # never BOOT a cluster as a side effect (_ensure_client
+        # auto-inits). A handle built before init() arms lazily on its
+        # first pick (_pushed_version runs on every staleness check).
+        from ray_tpu import api as _api
+
+        if _api._client is not None:
+            try:
+                _pushed_version()
+                _dead_actors()
+            except Exception as e:
+                logger.debug("push subscription arm failed (handle will "
+                             "poll): %s", e)
 
     def _refresh(self, force: bool = False):
         ctrl = _get_controller()
@@ -186,32 +294,42 @@ class DeploymentHandle:
             self._version = table["version"]
             route = table["routes"].get(self.deployment_name)
             self._replicas = route["replicas"] if route else []
+            self._loads = (route.get("loads") or {}) if route else {}
+            tbl_ts = table.get("ts")
+            self._loads_ref = (None if tbl_ts is None
+                               else (float(tbl_ts), time.monotonic()))
+            self._overload_pinned = bool(
+                route.get("overload_pinned")) if route else False
 
     def _alive(self, replicas: list) -> list:
-        """Drop replicas this client already knows are dead (pubsub)."""
-        from ray_tpu import api as _api
+        """Drop replicas this process knows are dead — O(1) set lookups
+        against the pubsub-fed dead set (note_dead() pre-seeds observed
+        corpses), never a per-replica client lookup on the hot path."""
+        dead = _dead_state["dead"]
+        if not dead:
+            return list(replicas)
+        return [r for r in replicas
+                if r._actor_id.binary() not in dead]
 
-        client = _api._ensure_client()
-        return [
-            r for r in replicas
-            if not client.actor_state(r._actor_id.binary()).dead
-        ]
-
-    def evict_replica(self, replica) -> None:
+    def evict_replica(self, replica, dead: bool = False) -> None:
         """Failover hint: drop a replica from the cached route table NOW
         (a caller just observed it die or reject work while draining).
         The pubsub death notification / controller routing bump carry the
         same fact, but may lag the very next pick — without this an
         immediate no-backoff retry can land on the same corpse and burn
         the whole failover budget. Purely local: a still-routable replica
-        reappears on the next table refresh."""
+        reappears on the next table refresh. `dead=True` (the caller
+        watched it DIE, not merely drain) additionally seeds the
+        process-wide dead set so every handle's next pick filters it."""
         aid = replica._actor_id.binary()
+        if dead:
+            note_dead(aid)
         with self._lock:
             self._replicas = [r for r in self._replicas
                               if r._actor_id.binary() != aid]
             self._local_inflight.pop(aid, None)
 
-    def _pick_replica(self):
+    def _pick_replica(self, affinity_key: bytes | None = None):
         replicas: list = []
         for attempt in range(4):
             with self._lock:
@@ -263,22 +381,66 @@ class DeploymentHandle:
             raise RuntimeError(
                 f"no replicas for deployment {self.deployment_name!r}"
             )
-        return self._p2c(replicas)
+        return self._p2c(replicas, affinity_key)
 
-    def _p2c(self, replicas: list):
-        """Power-of-two-choices on the handle's OWN outstanding counts — no
-        per-request RPC round trip."""
+    def _row_age(self, row: dict) -> float:
+        """Probe age of a pushed load row, skew-free: (table build time
+        − probe time) on the CONTROLLER's clock, plus local monotonic
+        time since the table arrived — both same-clock differences, so
+        cross-node wall-clock skew can't silently mark every probe
+        stale (disabling blended routing and shedding) or fresh-forever.
+        Falls back to a local wall-clock diff when no table receipt is
+        recorded (rows injected directly, e.g. tests)."""
+        ts = float(row.get("ts") or 0.0)
+        ref = self._loads_ref
+        if ref is not None:
+            tbl_ts, received = ref
+            return max(0.0, tbl_ts - ts) + (time.monotonic() - received)
+        return max(0.0, time.time() - ts)
+
+    def _blended(self, replica) -> float:
+        """Blended load score: handle-local in-flight plus the replica's
+        last-probed ongoing (inflight + queued), weighted down linearly
+        with probe age so a stale probe decays to the local-only signal
+        instead of blackholing traffic on old news."""
+        aid = replica._actor_id
+        with self._lock:
+            local = self._local_inflight.get(aid.binary(), 0)
+            row = self._loads.get(aid.hex())
+        if row is None:
+            return float(local)
+        w = max(0.0, 1.0 - self._row_age(row) / self._load_stale_s)
+        return local + w * float(row.get("ongoing", 0.0))
+
+    def _p2c(self, replicas: list, affinity_key: bytes | None = None):
+        """Replica selection per serve_router_policy.
+
+        p2c_local: power-of-two-choices on the handle's OWN outstanding
+        counts — byte-for-byte the legacy router, no per-request RPC.
+        p2c_load: the same two random choices compared on the BLENDED
+        score (_blended) so cluster-wide queue depth steers the pick.
+        affinity: the rendezvous-hashed preferred replica for the
+        request's prefix key, unless its blended load crossed the spill
+        threshold — then fall through to the p2c_load pick (affinity
+        never defeats load balancing)."""
         import random
 
         if len(replicas) == 1:
             return replicas[0]
+        if affinity_key is not None and self._policy == "affinity":
+            pref = _rendezvous(affinity_key, replicas)
+            if self._blended(pref) < self._spill_ongoing:
+                return pref
+            # Preferred replica is hot: spill to the load-balanced pick.
         a, b = random.sample(replicas, 2)
-        with self._lock:
-            la = self._local_inflight.get(a._actor_id.binary(), 0)
-            lb = self._local_inflight.get(b._actor_id.binary(), 0)
-        return a if la <= lb else b
+        if self._policy == "p2c_local":
+            with self._lock:
+                la = self._local_inflight.get(a._actor_id.binary(), 0)
+                lb = self._local_inflight.get(b._actor_id.binary(), 0)
+            return a if la <= lb else b
+        return a if self._blended(a) <= self._blended(b) else b
 
-    def try_pick_replica(self):
+    def try_pick_replica(self, affinity_key: bytes | None = None):
         """Non-blocking replica pick: a replica when the route cache is
         fresh and has live replicas, else None (caller falls back to the
         blocking _pick_replica off-loop). The async ingress fast path."""
@@ -290,7 +452,51 @@ class DeploymentHandle:
             replicas = [] if stale else self._alive(self._replicas)
         if not replicas:
             return None
-        return self._p2c(replicas)
+        return self._p2c(replicas, affinity_key)
+
+    def affinity_key(self, payload) -> bytes | None:
+        """Prefix-affinity key for a request payload (None unless the
+        policy is `affinity` and the payload carries prompt_ids): the
+        chunk-chain head digest, so equal prefixes rendezvous to the
+        replica whose prefix cache is already warm."""
+        if self._policy != "affinity" or not isinstance(payload, dict):
+            return None
+        ids = payload.get("prompt_ids")
+        if not ids:
+            return None
+        from ray_tpu.serve.prefix_cache import affinity_key as _akey
+
+        try:
+            return _akey(ids, self._affinity_chunk)
+        except Exception as e:
+            # Unhashable payload (wrong dtype/shape): route by load.
+            logger.debug("affinity key failed (routing by load): %s", e)
+            return None
+
+    def shed_verdict(self) -> dict | None:
+        """Overload-shed gate for the ingress: a verdict dict when new
+        work should be shed, else None. Sheds ONLY when the autoscaler
+        reports the recommendation pinned at max_replicas (pushed with
+        the routing table) AND every FRESH-probed replica's queue depth
+        exceeds serve_overload_queue_depth — scaling can't absorb more
+        and queues are past the knee, so bounded degradation (typed 503
+        + Retry-After at the proxy) beats unbounded TTFT burn. Stale
+        probes never shed: no fresh evidence, no degradation."""
+        if self._shed_queue_depth <= 0:
+            return None
+        with self._lock:
+            if not self._overload_pinned or not self._loads:
+                return None
+            rows = list(self._loads.values())
+        fresh = [r for r in rows
+                 if self._row_age(r) <= self._load_stale_s]
+        if not fresh:
+            return None
+        qmin = min(float(r.get("queue_depth", 0.0)) for r in fresh)
+        if qmin <= self._shed_queue_depth:
+            return None
+        return {"retry_after_s": self._shed_retry_after_s,
+                "queue_depth_min": qmin}
 
     def _track(self, aid: bytes, ref) -> None:
         """Count a dispatch against `aid` until its result ref resolves."""
@@ -328,7 +534,11 @@ class DeploymentHandle:
         return ref
 
     def method(self, method_name: str, *args, **kwargs):
-        return self.dispatch(self._pick_replica(), method_name, args, kwargs)
+        # Dict payloads with prompt_ids rendezvous-route under the
+        # affinity policy; everything else picks by load.
+        key = self.affinity_key(args[0]) if args else None
+        return self.dispatch(self._pick_replica(key), method_name, args,
+                             kwargs)
 
     def stream(self, request: dict, *,
                submit_method: str = "submit_stream",
@@ -363,31 +573,39 @@ class DeploymentHandle:
             t_end = _time.monotonic() + deadline_s
             replica = None
             sid = None
+            # Prefix affinity holds for the FIRST placement only: a
+            # resume after death/drain re-picks purely by load (the
+            # preferred replica just proved unreliable, and the PR 9
+            # teacher-forced re-prefill works anywhere).
+            key = self.affinity_key(request)
 
             def _call(replica, method, *call_args):
                 # Tracked like method() dispatches: long token streams
                 # must weigh on the local p2c signal.
                 return self.dispatch(replica, method, call_args, {})
 
-            def _resume(mode: str, victim) -> bool:
+            def _resume(mode: str, victim, dead: bool = False) -> bool:
                 # Mirrors HTTPProxy._stream_sse._failover — the protocol
                 # invariants live in that docstring; keep both in sync.
-                nonlocal budget, sid
+                # Only a CONFIRMED death (ActorDiedError) may seed the
+                # process-wide dead set.
+                nonlocal budget, sid, key
                 if budget <= 0:
                     return False
                 budget -= 1
                 if victim is not None:
-                    self.evict_replica(victim)
+                    self.evict_replica(victim, dead=dead)
                 _FAILOVERS.inc(1.0, tags={
                     "route": self.deployment_name,
                     "mode": f"stream_{mode}"})
                 sid = None
+                key = None          # failover re-picks by load
                 return True
 
             while True:
                 try:
                     if sid is None:
-                        replica = self._pick_replica()
+                        replica = self._pick_replica(key)
                         req = dict(request)
                         if emitted:
                             req["generated_ids"] = list(emitted)
@@ -400,8 +618,11 @@ class DeploymentHandle:
                               poll_timeout_s),
                         timeout=60)
                 except Exception as e:  # noqa: BLE001 — classified below
+                    from ray_tpu.serve.http_proxy import confirmed_dead
+
                     mode = failover_mode(e)
-                    if mode is not None and _resume(mode, replica):
+                    if mode is not None and _resume(mode, replica,
+                                                    confirmed_dead(e)):
                         continue
                     raise
                 for tok in out["tokens"]:

@@ -265,6 +265,7 @@ class ShadowAutoscaler:
             st["over_since"] = st["under_since"] = None
             return {**base, "rule": "no_data", "changed": False,
                     "recommended_replicas": rec_prev,
+                    "pinned_at_max": False,
                     "hysteresis": self._hyst(st, now)}
         # Raw desire: capacity for the windowed mean demand...
         desired = clamp(math.ceil(
@@ -322,6 +323,12 @@ class ShadowAutoscaler:
         st["recommended"] = recommended
         return {**base, "rule": rule, "desired_raw": desired,
                 "recommended_replicas": recommended, "changed": changed,
+                # Demand at/above the clamp with the recommendation
+                # already there: scaling can't help any further — the
+                # overload-shedding gate (proxy 503 + Retry-After)
+                # reads this off the routing table.
+                "pinned_at_max": (recommended >= policy.max_replicas
+                                  and desired >= policy.max_replicas),
                 "hysteresis": self._hyst(st, now)}
 
     @staticmethod

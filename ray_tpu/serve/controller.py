@@ -347,19 +347,65 @@ class ServeController:
             self._shadow.forget(name)
         return True
 
+    @staticmethod
+    def _load_row(s: dict) -> dict:
+        """Compact per-replica load row shipped to handles with the
+        routing table (the router's blended-p2c / shed signal): the same
+        fields _record_load_history exports as gauges, plus the probe
+        wall time so consumers can staleness-decay a lagging probe."""
+        load = s.get("load") or {}
+        qd = float(load.get("queue_depth", 0.0))
+        return {
+            "queue_depth": qd,
+            "ongoing": float(s.get("inflight", 0.0)) + qd,
+            "ttft_ewma_ms": float(load.get("ttft_ewma_ms", 0.0)),
+            "kv_pages_free": float(load.get("pool_pages_free", 0.0)),
+            "prefix_cache_hit_rate": float(
+                load.get("prefix_cache_hit_rate", 0.0)),
+            "ts": s.get("ts", 0.0),
+        }
+
     def get_routing(self, known_version: int = -1) -> dict | None:
-        """Routing table for handles/proxies; None if caller is up to date."""
+        """Routing table for handles/proxies; None if caller is up to date.
+
+        Besides replica membership, every push carries the per-replica
+        LOAD table from the last reconcile probe (queue depth, ongoing,
+        TTFT EWMA, kv pages free, prefix-cache hit rate + probe wall
+        time) and the overload verdict — the reconcile loop bumps the
+        version on every probe round, so handles see fresh load at push
+        cadence with zero extra RPCs (the load rides the same pubsub
+        bump + table fetch the routing layer already does)."""
         if known_version == self.version:
             return None
         routes = {}
+        # Table build time on the CONTROLLER's clock: consumers compute
+        # probe age as (table_ts - row_ts) + local time since receipt —
+        # both same-clock differences, so cross-node wall-clock skew
+        # can't silently disable blended routing / shedding.
+        now = time.time()
         with self._lock:
             for name, d in self.deployments.items():
+                live = {aid for aid, _h in d["replicas"]}
                 routes[name] = {
                     "replicas": [h for (_aid, h) in d["replicas"]],
                     "route_prefix": d["route_prefix"],
                     "max_concurrent_queries": d["max_concurrent_queries"],
+                    "loads": {
+                        aid: self._load_row(s)
+                        for aid, s in (d.get("replica_load") or {}).items()
+                        if aid in live
+                    },
+                    # Shed gate (http_proxy): the autoscaler says demand
+                    # is at/above max_replicas AND the fleet is fully
+                    # deployed — scaling can't absorb any more, so
+                    # degradation policy takes over. Guarded on full
+                    # deployment so a still-booting fleet (capacity
+                    # coming) never sheds early.
+                    "overload_pinned": bool(
+                        d.get("overload_pinned")
+                        and len(d["replicas"]) >= d["num_replicas"]),
                 }
-        return {"version": self.version, "routes": routes}
+        return {"version": self.version, "ts": now, "routes": routes}
 
     def request_scale_up(self, name: str) -> bool:
         """Cold-start trigger from a handle that found zero replicas (the
@@ -496,6 +542,10 @@ class ServeController:
 
         def _publish():
             try:
+                # Chaos fault point: a "drop" rule here loses the push —
+                # handles/proxies must keep serving from their cached
+                # table and converge through the TTL refresh.
+                _chaos.hit("serve.routes.push")
                 from ray_tpu import api as _api
 
                 _api._ensure_client().publish(ROUTES_CHANNEL, {"version": v})
@@ -778,6 +828,9 @@ class ServeController:
                     s = ray_tpu.get(ref, timeout=5)
                     ok = True
                     if not is_starting:
+                        # Probe wall time rides into the pushed load
+                        # table so routers can staleness-decay it.
+                        s["ts"] = time.time()
                         stats.append((aid, s))
                 except ActorDiedError:
                     died = True
@@ -819,6 +872,7 @@ class ServeController:
                     del self._health_fails[aid]
         start_timeout = getattr(
             self._cfg, "serve_replica_start_timeout_s", 180.0)
+        load_refreshed = False
         with self._lock:
             for name, (gen, drop, promote, drop_start, stats) in \
                     probed.items():
@@ -894,6 +948,14 @@ class ServeController:
                 if changed:
                     self._bump_version_locked()
                     self._checkpoint_locked()
+                elif stats:
+                    load_refreshed = True
+            if load_refreshed:
+                # Load-only refresh: ONE push for the whole probe round
+                # (same pubsub bump the routing table uses) WITHOUT a
+                # checkpoint write — load is runtime-only state a
+                # restarted controller re-probes anyway.
+                self._bump_version_locked()
         if only is None:
             # Full passes own the cross-deployment bookkeeping: retire
             # history series of replicas that left, then let the shadow
@@ -913,16 +975,9 @@ class ServeController:
             s = d.get("replica_load", {}).get(aid)
             if s is None:
                 continue
-            load = s.get("load") or {}
-            qd = float(load.get("queue_depth", 0.0))
-            vals = {
-                "queue_depth": qd,
-                "ongoing": float(s.get("inflight", 0.0)) + qd,
-                "ttft_ewma_ms": float(load.get("ttft_ewma_ms", 0.0)),
-                "kv_pages_free": float(load.get("pool_pages_free", 0.0)),
-                "prefix_cache_hit_rate": float(
-                    load.get("prefix_cache_hit_rate", 0.0)),
-            }
+            # Same extraction as the routing-table push: the gauge
+            # history and the router's pushed load must never diverge.
+            vals = self._load_row(s)
             tags = {"deployment": name, "replica": aid[-8:]}
             for key, gauge in _REPLICA_LOAD_GAUGES.items():
                 gauge.set(vals[key], tags=tags)
@@ -984,9 +1039,32 @@ class ServeController:
                 # rest (or the reconcile loop hosting this).
                 logger.exception("shadow autoscale failed for %s", name)
                 continue
+            with self._lock:
+                d = self.deployments.get(name)
+                if d is not None:
+                    # Overload-shed gate input (routing table push):
+                    # the recommendation is pinned at max_replicas AND
+                    # scaling is genuinely exhausted — in enact mode the
+                    # recommendation IS the count; in shadow mode
+                    # nothing enacts it, so the count itself must
+                    # already sit at the policy max. Without that gate a
+                    # shadow-mode deployment far below max would shed
+                    # queued-but-servable traffic on an observe-only
+                    # recommendation.
+                    d["overload_pinned"] = bool(
+                        record.get("pinned_at_max")
+                        and (self._shadow.mode == "enact"
+                             or cur >= policy.max_replicas))
             if self._shadow.mode != "enact" or not record["changed"]:
                 continue
             rec = record["recommended_replicas"]
+            # Blast-radius guard: one enactment moves num_replicas at
+            # most max_enact_step — a single bad decision window can't
+            # mass-kill (or mass-spawn) a fleet. The autoscaler
+            # re-anchors on the actual count each evaluation, so a
+            # clamped move converges over cooldown-spaced steps.
+            step = max(1, int(getattr(
+                self._cfg, "serve_autoscale_max_enact_step", 8)))
             with self._lock:
                 d = self.deployments.get(name)
                 if rec < 1 and d is not None:
@@ -1001,10 +1079,20 @@ class ServeController:
                             time.monotonic() - cold < grace:
                         continue
                 if d is not None and d["num_replicas"] != rec:
-                    logger.info("autoscale enact: %s %d -> %d (%s)",
-                                name, d["num_replicas"], rec,
-                                record["rule"])
-                    d["num_replicas"] = rec
+                    cur_n = d["num_replicas"]
+                    target = max(cur_n - step, min(cur_n + step, rec))
+                    # Chaos fault point: a "kill" rule here dies BETWEEN
+                    # the decision record (already retained/published by
+                    # evaluate()) and the scale apply — the restarted
+                    # controller must RE-DERIVE the recommendation from
+                    # the series store against its checkpointed
+                    # (pre-enact) num_replicas, never double-apply.
+                    _chaos.hit("serve.controller.enact")
+                    logger.info("autoscale enact: %s %d -> %d (%s%s)",
+                                name, cur_n, target, record["rule"],
+                                "" if target == rec
+                                else f", clamped from {rec}")
+                    d["num_replicas"] = target
                     d["over_since"] = None
                     d["under_since"] = None
                     self._checkpoint_locked()

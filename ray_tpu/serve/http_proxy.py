@@ -66,6 +66,23 @@ _REQS_FAILED = profiling.Counter(
     "serve_requests_failed_total",
     description="Ingress requests that returned an error to the client",
     tag_keys=("route", "reason"))
+# Overload shedding (bounded degradation): requests refused with a typed
+# 503 + Retry-After because the deployment's autoscaler is pinned at
+# max_replicas and every replica's probed queue depth crossed
+# serve_overload_queue_depth — shed, not queued, so in-flight decodes
+# keep their latency while the overflow gets an honest retry signal.
+_REQS_SHED = profiling.Counter(
+    "serve_requests_shed_total",
+    description="Ingress requests shed under pinned-at-max overload",
+    tag_keys=("route",))
+
+
+def _shed_body(shed: dict) -> bytes:
+    return json.dumps({
+        "error": "overloaded", "type": "overloaded",
+        "retry_after_s": shed["retry_after_s"],
+        "queue_depth_min": shed.get("queue_depth_min"),
+    }).encode()
 
 
 # Drain/migration rejections cross the actor boundary as RayTaskError
@@ -91,6 +108,18 @@ def failover_mode(e: BaseException) -> str | None:
     if any(m in s for m in _DRAIN_MARKERS):
         return "drain"
     return None
+
+
+def confirmed_dead(e: BaseException) -> bool:
+    """True only for a DEFINITIVE death (ActorDiedError — the raylet
+    watched the worker die). ActorUnavailableError also failovers as
+    "death" but can be transient (dial timeout, slow start), so it must
+    never seed the process-wide dead set — an entry there outlives
+    every routing-table refresh and would permanently blacklist a live
+    replica."""
+    from ray_tpu.exceptions import ActorDiedError
+
+    return isinstance(e, ActorDiedError)
 
 
 def _decode_payload(command: str, parsed, headers: dict, body: bytes):
@@ -377,6 +406,27 @@ class HTTPProxy(_RouterMixin):
             self._inflight += 1
             try:
                 handle = self._handle(name)
+                shed = handle.shed_verdict()
+                if shed is not None:
+                    # Pinned at max + queues past the knee: shed with a
+                    # typed 503 + Retry-After (an SSE request gets the
+                    # typed error event in event-stream framing) instead
+                    # of burning TTFT unboundedly.
+                    status = 503
+                    reason = "shed"
+                    _REQS_SHED.inc(1.0, tags={"route": route})
+                    retry = (b"Retry-After", str(max(1, round(
+                        shed["retry_after_s"]))).encode())
+                    if wants_stream:
+                        await self._send(
+                            writer, 503,
+                            b"data: " + _shed_body(shed) + b"\n\n",
+                            ctype=b"text/event-stream",
+                            extra=(retry,) + trace_headers)
+                    else:
+                        await self._send(writer, 503, _shed_body(shed),
+                                         extra=(retry,) + trace_headers)
+                    return False
                 if wants_stream and isinstance(payload, dict):
                     status = 200
                     return await self._stream_sse(
@@ -421,7 +471,7 @@ class HTTPProxy(_RouterMixin):
                 args=tracing.span_event_args(ctx, route=route,
                                              status=status))
 
-    async def _pick(self, name: str, handle):
+    async def _pick(self, name: str, handle, affinity_key=None):
         """Pick a replica for one request.
 
         Fast path (fresh route cache, live replicas): inline on the loop —
@@ -434,15 +484,17 @@ class HTTPProxy(_RouterMixin):
         start, replica selection) — observed here, once, for every path
         that dispatches."""
         t0 = time.time()
-        replica = handle.try_pick_replica()
+        replica = handle.try_pick_replica(affinity_key)
         if replica is None:
             lock = self._dep_locks.setdefault(name, asyncio.Lock())
             async with lock:
-                replica = handle.try_pick_replica()  # fixed by a prior waiter?
+                # fixed by a prior waiter?
+                replica = handle.try_pick_replica(affinity_key)
                 if replica is None:
                     loop = asyncio.get_running_loop()
                     replica = await loop.run_in_executor(
-                        self._pool, handle._pick_replica)
+                        self._pool,
+                        lambda: handle._pick_replica(affinity_key))
         _QUEUE_WAIT.observe(time.time() - t0, tags={"route": name})
         return replica
 
@@ -451,9 +503,11 @@ class HTTPProxy(_RouterMixin):
         death (ActorDiedError out of the dispatch/await) or drain
         rejection retries immediately against a re-picked replica before
         the client sees any error. The unary path delivers nothing until
-        completion, so a full re-run is side-effect-safe."""
+        completion, so a full re-run is side-effect-safe. Prefix
+        affinity steers the FIRST pick only — retries re-pick by load."""
+        key = handle.affinity_key(payload)
         for attempt in range(self._failover_attempts + 1):
-            replica = await self._pick(name, handle)
+            replica = await self._pick(name, handle, key)
             try:
                 ref = handle.dispatch(replica, "__call__", (payload,), {})
                 return await self._await_ref(ref)
@@ -465,7 +519,8 @@ class HTTPProxy(_RouterMixin):
                 # — the pubsub death notification / routing bump may lag
                 # one pick, and a no-backoff retry that lands on the same
                 # replica just burns the failover budget.
-                handle.evict_replica(replica)
+                handle.evict_replica(replica, dead=confirmed_dead(e))
+                key = None
                 _FAILOVERS.inc(1.0, tags={"route": name,
                                           "mode": f"unary_{mode}"})
         raise RuntimeError("unreachable")  # loop always returns or raises
@@ -509,26 +564,31 @@ class HTTPProxy(_RouterMixin):
         headers_sent = False
         replica = None
         sid = None
+        # Affinity steers the first placement only: a resume after
+        # death/drain re-picks purely by load (PR 9 resubmit contract).
+        key = handle.affinity_key(payload)
 
-        async def _failover(mode: str, victim) -> bool:
-            nonlocal attempts_left, sid
+        async def _failover(mode: str, victim, dead: bool = False) -> bool:
+            nonlocal attempts_left, sid, key
             if attempts_left <= 0:
                 return False
             attempts_left -= 1
             if victim is not None:
                 # Dead OR draining: either way this replica must not be
-                # re-picked by the immediate retry below.
-                handle.evict_replica(victim)
+                # re-picked by the immediate retry below. Only a
+                # CONFIRMED death seeds the process-wide dead set.
+                handle.evict_replica(victim, dead=dead)
             _FAILOVERS.inc(1.0, tags={"route": name,
                                       "mode": f"stream_{mode}"})
             sid = None           # re-pick + resubmit on the next loop turn
+            key = None
             return True
 
         try:
             while True:
                 try:
                     if sid is None:
-                        replica = await self._pick(name, handle)
+                        replica = await self._pick(name, handle, key)
                         req = dict(payload)
                         if emitted:
                             req["generated_ids"] = list(emitted)
@@ -539,7 +599,8 @@ class HTTPProxy(_RouterMixin):
                         replica, "stream_read", (sid, cursor, 0.25), {}))
                 except Exception as e:  # noqa: BLE001 — classified below
                     mode = failover_mode(e)
-                    if mode is not None and await _failover(mode, replica):
+                    if mode is not None and await _failover(
+                            mode, replica, confirmed_dead(e)):
                         continue
                     raise
                 if not headers_sent:
@@ -635,12 +696,15 @@ class ThreadedHTTPProxy(_RouterMixin):
             def log_message(self, *a):  # quiet
                 pass
 
-            def _json_reply(self, code: int, body: bytes):
+            def _json_reply(self, code: int, body: bytes,
+                            headers: tuple = ()):
                 # HTTP/1.1 keep-alive: the body MUST be delimited by
                 # Content-Length or the client blocks waiting for EOF.
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -671,6 +735,35 @@ class ThreadedHTTPProxy(_RouterMixin):
                     import ray_tpu
                     from ray_tpu.core.config import runtime_config
 
+                    shed = handle.shed_verdict()
+                    if shed is not None:
+                        # Sync mirror of the async proxy's shed path
+                        # (typed 503 + Retry-After; the async proxy owns
+                        # the canonical semantics — keep in sync). An
+                        # SSE request gets the typed error event in
+                        # event-stream framing + Connection: close — a
+                        # JSON body on a keep-alive socket would leave
+                        # an SSE consumer waiting for frames/EOF until
+                        # its own timeout.
+                        _REQS_SHED.inc(1.0, tags={"route": name})
+                        _REQS_FAILED.inc(1.0, tags={"route": name,
+                                                    "reason": "shed"})
+                        retry = str(max(1, round(shed["retry_after_s"])))
+                        if wants_stream:
+                            body = b"data: " + _shed_body(shed) + b"\n\n"
+                            self.close_connection = True
+                            self.send_response(503)
+                            self.send_header("Content-Type",
+                                             "text/event-stream")
+                            self.send_header("Retry-After", retry)
+                            self.send_header("Connection", "close")
+                            self.end_headers()
+                            self.wfile.write(body)
+                        else:
+                            self._json_reply(
+                                503, _shed_body(shed),
+                                headers=(("Retry-After", retry),))
+                        return
                     if wants_stream and isinstance(payload, dict):
                         # handle.stream resumes across replica death /
                         # drain internally (cursor-exact splice).
@@ -682,8 +775,9 @@ class ThreadedHTTPProxy(_RouterMixin):
                     # proxy owns the canonical semantics — keep in sync).
                     attempts = max(
                         0, runtime_config().serve_failover_attempts)
+                    key = handle.affinity_key(payload)
                     for attempt in range(attempts + 1):
-                        replica = handle._pick_replica()
+                        replica = handle._pick_replica(key)
                         try:
                             result = ray_tpu.get(
                                 handle.dispatch(
@@ -694,7 +788,9 @@ class ThreadedHTTPProxy(_RouterMixin):
                             mode = failover_mode(e)
                             if mode is None or attempt >= attempts:
                                 raise
-                            handle.evict_replica(replica)
+                            handle.evict_replica(
+                                replica, dead=confirmed_dead(e))
+                            key = None
                             _FAILOVERS.inc(1.0, tags={
                                 "route": name, "mode": f"unary_{mode}"})
                     self._json_reply(

@@ -874,6 +874,11 @@ class LLMEngine:
                 snap["prefix_cache_entries"] = len(self.prefix_cache.entries)
                 snap["prefix_cache_pages"] = (
                     self.prefix_cache.n_pages_cached())
+                # Raw counts ride along so cross-replica consumers (the
+                # affinity-vs-load bench) can aggregate hit rates with
+                # real weights instead of averaging per-replica rates.
+                snap["prefix_cache_hits"] = self.stats["prefix_hits"]
+                snap["prefix_cache_misses"] = self.stats["prefix_misses"]
                 looked = (self.stats["prefix_hits"]
                           + self.stats["prefix_misses"])
                 if looked:

@@ -92,6 +92,22 @@ def chunk_hashes(tokens, chunk: int) -> list[bytes]:
     return extend_chunk_chain(tokens, chunk, [])
 
 
+def affinity_key(tokens, chunk: int) -> bytes:
+    """Routing affinity key: the chunk-chain HEAD digest over the first
+    ``chunk`` tokens — byte-identical to the depth-1 key the cache's
+    donations/lookups use, so requests that rendezvous-route on this key
+    land on the replica whose cache already holds their prefix chain
+    (cache locality for free, no cross-replica protocol). Prompts
+    shorter than one chunk hash whatever they have: such keys never
+    match a cache entry (entries are chunk-aligned), but equal short
+    prompts still co-locate."""
+    if chunk > 0 and len(tokens) >= chunk:
+        return chunk_hashes(tokens[:chunk], chunk)[0]
+    h = hashlib.blake2b(b"", digest_size=16)
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.digest()
+
+
 @dataclasses.dataclass
 class CacheEntry:
     key: bytes
@@ -292,4 +308,4 @@ class PrefixCache:
         return self._page_owners.get(int(page), 0)
 
 
-__all__ = ["PrefixCache", "CacheEntry", "chunk_hashes"]
+__all__ = ["PrefixCache", "CacheEntry", "chunk_hashes", "affinity_key"]
