@@ -23,6 +23,72 @@ import time
 import numpy as np
 
 
+def _resolve_draft_cfg(name, cfg):
+    """Resolve --spec-draft into a GPTConfig tied to the target's
+    tokenizer (vocab). "tiny1l" is the CPU-ablation draft: a 1-layer
+    half-width shrink of the TARGET config — an order of magnitude less
+    weight traffic per proposal, the cheap-proposer shape speculative
+    decoding wants. Any registry name works too; the engine rejects
+    vocab mismatches at construction."""
+    from ray_tpu.models import gpt
+
+    if name == "tiny1l":
+        return gpt.GPTConfig.tiny(
+            n_layers=1, d_model=cfg.d_model // 2,
+            n_heads=max(1, cfg.n_heads // 2), d_ff=cfg.d_ff // 2,
+            vocab_size=cfg.vocab_size, max_seq=cfg.max_seq,
+            dtype=cfg.dtype, attn_impl=cfg.attn_impl)
+    return gpt.GPTConfig.by_name(name)
+
+
+def _fit_periodic(cfg, params, pattern, steps):
+    """Adam-fit `params` to continue the repeated `pattern` (the
+    --repeat-period workload): rotations of the period tiled to one
+    sequence, next-token CE. Random weights measure nothing for
+    speculation — acceptance needs a draft that PREDICTS the target, and
+    both only predict the workload after seeing it. Deterministic
+    (fixed rotations, no data randomness) so the spec/nospec ablation
+    pair fits byte-identical target weights."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import gpt
+
+    period = len(pattern)
+    # One full period + 1 per row: every bigram of the cycle appears in
+    # every row, which is all memorization needs — longer sequences just
+    # multiply the per-step cost.
+    seq = min(cfg.max_seq - 1, period + 1)
+    batch = min(period, 8)
+    reps = seq // period + 2
+    tiled = pattern * reps
+    rows = np.stack([
+        np.asarray(tiled[(i * period) // batch:
+                         (i * period) // batch + seq + 1], np.int32)
+        for i in range(batch)])
+    tokens = jnp.asarray(rows[:, :-1])
+    targets = jnp.asarray(rows[:, 1:])
+    # 3e-3: converges to ~1e-3 CE within ~100 steps on every config the
+    # ablation uses; 1e-2 oscillates at d_model >= 512.
+    opt = optax.adam(3e-3)
+
+    @jax.jit
+    def fit_update(params, opt_state):
+        loss, grads = jax.value_and_grad(gpt.loss_fn)(
+            params, tokens, targets, cfg)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    opt_state = opt.init(params)
+    loss = None
+    for _ in range(steps):
+        params, opt_state, loss = fit_update(params, opt_state)
+    print(f"# fit {cfg.n_layers}L/{cfg.d_model}d to period {period}: "
+          f"final loss {float(loss):.4f} after {steps} steps", flush=True)
+    return params
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="tiny")
@@ -73,6 +139,34 @@ def main() -> None:
     ap.add_argument("--prefix-cache-pages", type=int, default=None,
                     help="max pool pages cache entries may pin"
                          " (default: half the pool)")
+    ap.add_argument("--spec-draft", default=None,
+                    help="speculative decoding draft model: a GPTConfig"
+                         " registry name, or 'tiny1l' (1-layer half-width"
+                         " tiny — the CPU-ablation draft). Requires"
+                         " --kv-mode paged and --prefill-chunk > 0; the"
+                         " draft proposes --spec-k tokens per slot per"
+                         " tick and the target scores all k+1 positions"
+                         " in one chunked verify pass")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per slot per tick")
+    ap.add_argument("--repeat-period", type=int, default=0,
+                    help="repetitive workload: prompts are random-phase"
+                         " rotations of one fixed token pattern of this"
+                         " period (the shape speculative decoding is"
+                         " built for — the greedy continuation repeats"
+                         " the period, so a competent draft tracks the"
+                         " target). 0 = fully random prompts")
+    ap.add_argument("--spec-fit-steps", type=int, default=0,
+                    help="fit the TARGET (and the draft, when"
+                         " --spec-draft is set) to the --repeat-period"
+                         " pattern for this many Adam steps before"
+                         " serving. Random weights measure nothing for"
+                         " speculation (acceptance needs a draft that"
+                         " actually predicts the target); the fit makes"
+                         " the CPU ablation reflect a competent"
+                         " draft/target pair. Applied to BOTH the spec"
+                         " and no-spec runs (same seed) so the ablation"
+                         " is weight-identical")
     ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
                     help="fraction of each prompt drawn from a small pool"
                          " of shared system prefixes (the millions-of-"
@@ -156,6 +250,32 @@ def main() -> None:
     if args.max_tokens_spread >= args.max_tokens:
         ap.error("--max-tokens-spread must be < --max-tokens"
                  " (a request must generate at least one token)")
+    if args.spec_draft and (args.kv_mode != "paged"
+                            or not args.prefill_chunk):
+        ap.error("--spec-draft requires --kv-mode paged and"
+                 " --prefill-chunk > 0 (the verify pass is a"
+                 " chunked-prefill row)")
+    if args.spec_fit_steps and not args.repeat_period:
+        ap.error("--spec-fit-steps needs --repeat-period (the fit"
+                 " corpus IS the repeated pattern)")
+    if args.repeat_period and (args.shared_prefix_frac or args.turns > 1):
+        ap.error("--repeat-period replaces the whole prompt generator"
+                 " (rotations of one pattern) — it cannot combine with"
+                 " --shared-prefix-frac/--turns workload shaping")
+    if args.real_replicas and (args.spec_draft or args.repeat_period
+                               or args.spec_fit_steps):
+        ap.error("--real-replicas does not drive the speculative flags"
+                 " (--spec-draft/--repeat-period/--spec-fit-steps run"
+                 " against the in-process engine only)")
+    if args.real_replicas and args.model == "tiny25m":
+        ap.error("--model tiny25m is the in-process ablation config;"
+                 " replica deployments resolve models by registry name")
+    if args.spec_k < 1:
+        ap.error("--spec-k must be >= 1")
+    if args.repeat_period and args.repeat_period < 1:
+        ap.error("--repeat-period must be >= 1")
+    if args.spec_fit_steps and args.spec_fit_steps < 1:
+        ap.error("--spec-fit-steps must be >= 1")
     phases = None
     if args.ramp:
         try:
@@ -172,7 +292,7 @@ def main() -> None:
         _run_real(args, phases)
         return
 
-    if args.model == "tiny":
+    if args.model in ("tiny", "tiny25m"):
         # CI path: force the CPU backend before jax initializes.
         from ray_tpu.utils.platform import force_cpu_devices
 
@@ -181,18 +301,64 @@ def main() -> None:
     from ray_tpu.models import gpt
     from ray_tpu.serve.llm import LLMEngine
 
-    cfg = gpt.GPTConfig.by_name(args.model)
+    if args.model == "tiny25m":
+        # CPU stand-in for the chip's weight-bound decode regime: ~25M
+        # params (~100 MB fp32 weight traffic per pass) makes a decode
+        # step memory-bandwidth-bound even on CPU, where the 64-dim
+        # `tiny` is pure dispatch overhead. The speculative ablation
+        # runs here: a k+1-token verify pass streams the same weights as
+        # a 1-token decode step, which is the whole speculative bet.
+        cfg = gpt.GPTConfig.tiny(d_model=512, n_layers=8, d_ff=2048)
+    else:
+        cfg = gpt.GPTConfig.by_name(args.model)
     params = None
+    rng = np.random.default_rng(0)
+    # Repetitive workload (speculative-decoding ablation): one fixed
+    # pattern; every prompt is a random-phase rotation of it, so the
+    # greedy continuation of a fitted model repeats the period. Sampled
+    # WITHOUT replacement: distinct tokens make the continuation a
+    # deterministic bigram map, learnable by a 1-layer draft — a
+    # duplicated token would need 2-layer induction to disambiguate,
+    # which quietly zeroes the draft's acceptance.
+    pattern = None
+    if args.repeat_period:
+        if args.repeat_period > cfg.vocab_size:
+            ap.error("--repeat-period must be <= the model vocab size")
+        pattern = list(map(int, rng.choice(
+            cfg.vocab_size, args.repeat_period, replace=False)))
+    draft_cfg = draft_params = None
+    if args.spec_draft:
+        draft_cfg = _resolve_draft_cfg(args.spec_draft, cfg)
+    if args.spec_fit_steps:
+        import jax
+
+        # Fit in fp32 ALWAYS (Adam updates into bf16 storage lose the
+        # sub-ulp tail and the fit plateaus early); --bf16 casts the
+        # fitted result below, the same master-weights-then-serve shape
+        # real deployments use.
+        if params is None:
+            params = gpt.init_params(cfg, jax.random.key(0))
+        params = _fit_periodic(cfg, params, pattern, args.spec_fit_steps)
+        if draft_cfg is not None:
+            draft_params = _fit_periodic(
+                draft_cfg, gpt.init_params(draft_cfg, jax.random.key(1)),
+                pattern, args.spec_fit_steps)
     if args.bf16:
         # Serving-standard bf16 weights: decode is HBM-bound, fp32 masters
-        # would double the per-token weight traffic.
+        # would double the per-token weight traffic. Applied AFTER the
+        # fit, to target and draft alike.
         import jax
         import jax.numpy as jnp
 
-        params = jax.tree.map(
-            lambda a: a.astype(jnp.bfloat16)
-            if a.dtype == jnp.float32 else a,
-            gpt.init_params(cfg, jax.random.key(0)))
+        def _to_bf16(tree):
+            return jax.tree.map(
+                lambda a: a.astype(jnp.bfloat16)
+                if a.dtype == jnp.float32 else a, tree)
+
+        params = _to_bf16(params if params is not None
+                          else gpt.init_params(cfg, jax.random.key(0)))
+        if draft_params is not None:
+            draft_params = _to_bf16(draft_params)
     engine = LLMEngine(cfg, params, n_slots=args.n_slots,
                        max_len=args.max_len,
                        decode_block=args.decode_block,
@@ -201,8 +367,9 @@ def main() -> None:
                        prefill_chunk=args.prefill_chunk,
                        prefill_token_budget=args.prefill_budget,
                        prefix_cache=args.prefix_cache or None,
-                       prefix_cache_pages=args.prefix_cache_pages)
-    rng = np.random.default_rng(0)
+                       prefix_cache_pages=args.prefix_cache_pages,
+                       spec_draft=draft_cfg, spec_k=args.spec_k,
+                       spec_draft_params=draft_params)
     # Shared-prefix workload: a small pool of "system prompts" that a
     # fraction of every prompt is drawn from. Built up front so the
     # multiset is deterministic regardless of client scheduling.
@@ -219,7 +386,15 @@ def main() -> None:
         while not all(r.done.is_set() for r in reqs):
             engine.step()
 
-    prompt = lambda: list(rng.integers(0, cfg.vocab_size, args.prompt_len))
+    if pattern is not None:
+        reps = args.prompt_len // args.repeat_period + 2
+
+        def prompt():
+            phase = int(rng.integers(0, args.repeat_period))
+            return (pattern * reps)[phase:phase + args.prompt_len]
+    else:
+        prompt = lambda: list(
+            rng.integers(0, cfg.vocab_size, args.prompt_len))
     for burst in (8, 4, 2):
         if burst <= args.n_slots:
             drive([engine.submit(prompt(), max_tokens=2)
@@ -230,6 +405,14 @@ def main() -> None:
     # mid-measurement (seconds of XLA time booked against one window).
     drive([engine.submit(prompt(),
                          max_tokens=args.max_tokens + args.max_tokens_spread)])
+    # ... then a full-occupancy burst at the same output length: chunked
+    # admission staggers the slots' phases, so decode windows mix
+    # remaining-budget sizes — (window k, table width) combos a lone
+    # request never hits (e.g. small-k windows at the widest table)
+    # would otherwise compile mid-measurement.
+    drive([engine.submit(prompt(),
+                         max_tokens=args.max_tokens + args.max_tokens_spread)
+           for _ in range(args.n_slots)])
     # Engine-side counters restart here so the reported device-time split
     # covers ONLY the measured window (warmup compiles would skew it).
     engine.reset_stats()
@@ -265,8 +448,12 @@ def main() -> None:
                     return
                 i = todo.pop()
             uniq = args.prompt_len - shared_len
-            ids = (list(prefix_pool[i % len(prefix_pool)]) if prefix_pool
-                   else []) + list(rng.integers(0, cfg.vocab_size, uniq))
+            if pattern is not None:
+                ids = prompt()
+            else:
+                ids = (list(prefix_pool[i % len(prefix_pool)])
+                       if prefix_pool
+                       else []) + list(rng.integers(0, cfg.vocab_size, uniq))
             # --turns > 1: one conversation per request slot — every turn
             # after the first re-submits context the engine just served
             # (prompt + response + fresh user message), the multi-turn
@@ -391,6 +578,23 @@ def main() -> None:
         row["prefix_cache_cow_copies"] = em.get("cow_copies", 0)
         row["prefix_cached_tokens"] = em.get("prefix_cached_tokens", 0)
         row["prefix_cache_pages"] = em.get("prefix_cache_pages", 0)
+    # Workload + fit shape ride every row (spec or not) so the ablation
+    # pair is self-describing: the nospec arm runs the same repetitive
+    # workload against the same fitted target weights.
+    row["repeat_period"] = args.repeat_period
+    row["spec_fit_steps"] = args.spec_fit_steps
+    row["spec_draft"] = args.spec_draft or ""
+    row["spec_k"] = args.spec_k if args.spec_draft else 0
+    if args.spec_draft:
+        # accepted_per_step is the speculative headline: tokens emitted
+        # per slot per verify pass — 1.0 = non-speculative rate, k+1 the
+        # ceiling; engine tok/s should scale with it on a weight-bound
+        # decode.
+        row["accepted_per_step"] = em.get("spec_accepted_per_step", 0.0)
+        row["spec_accept_rate"] = em.get("spec_accept_rate", 0.0)
+        row["spec_proposed"] = em.get("spec_proposed", 0)
+        row["spec_accepted"] = em.get("spec_accepted", 0)
+        row["spec_verify_ticks"] = em.get("spec_ticks", 0)
     print(json.dumps(row), flush=True)
     if args.json_out:
         json.dump(row, open(args.json_out, "w"))

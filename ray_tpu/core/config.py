@@ -333,6 +333,25 @@ class Config:
     # evicted before the scheduler preempts a live decode). 0 = auto:
     # half the page pool.
     llm_prefix_cache_pages: int = 0
+    # Speculative decoding (serve/llm.py): draft model name (GPTConfig
+    # registry, e.g. "tiny") whose proposals the target verifies in ONE
+    # batched chunked-prefill pass per tick (models/paged_kv.py
+    # verify_chunk_paged — the PR 4 chunk program IS the verify program).
+    # Rejection sampling keeps greedy output byte-identical to
+    # non-speculative decode and temperature>0 distributionally exact.
+    # "" = off. Requires kv_mode="paged" AND llm_prefill_chunk > 0;
+    # alongside an incompatible engine the global knob soft-disables
+    # (explicit constructor args still error, like llm_prefill_chunk).
+    # NOTE: this knob names the draft ARCHITECTURE only — supply trained
+    # draft weights via LLMEngine(spec_draft_params=...) or
+    # LLMDeployment(spec_draft_checkpoint=...); a random-init draft has
+    # ~zero acceptance, making every tick strictly slower than
+    # non-speculative decode. Env: RAY_TPU_LLM_SPEC_DRAFT=tiny.
+    llm_spec_draft: str = ""
+    # Draft tokens proposed per active slot per engine tick (>= 1). The
+    # verify chunk is k+1 tokens wide; each tick emits between 1 (first
+    # proposal rejected) and k+1 (all accepted + bonus) tokens per slot.
+    llm_spec_k: int = 4
 
     # --- flight recorder (compile watch + SLO monitor) ---
     # Recompile-storm alarm (ray_tpu/compile_watch.py): a structured

@@ -129,46 +129,16 @@ def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
     return last, {"k": new_k, "v": new_v}
 
 
-@functools.partial(jax.jit, static_argnums=(0,),
-                   static_argnames=("return_logits", "attn_impl"),
-                   donate_argnums=(3,))
-def prefill_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
-                        offsets, n_valid, *, return_logits: bool = True,
-                        attn_impl: str = "gather"):
-    """Write ONE chunk per slot of up to N prompts' KV pages, each at its
-    own arbitrary token offset (Sarathi/Orca-style chunked prefill, one
-    fused dispatch per scheduler tick).
-
-    The compile-count fix for prefill: N and C are engine constants
-    (n_slots × chunk size), `offsets`/`n_valid` are traced vectors, and
-    `tables` are full-width page tables — so every chunk of every prompt
-    length, at any batch occupancy, lowers the same program. Exactly two
-    distinct prefill compilations total (``return_logits`` False for
-    interior-only batches, True when any row carries a final chunk, which
-    alone pays the LM head), replacing the one-shot path's
-    buckets × admission-ladder grid.
-
-    tokens: [N, C] (row = slot; tail chunks padded); tables: [N,
-    max_pages] page ids (pages covering positions
-    ``offsets[i] .. offsets[i]+n_valid[i]-1`` must be allocated);
-    offsets: [N] — absolute position of tokens[i, 0]; n_valid: [N] —
-    valid tokens in row i's chunk (0 = inert row: all writes land on the
-    null page and its logits row is garbage the engine ignores).
-
-    Queries attend causally over everything their slot has written so
-    far: each layer scatters the batch's K/V into its pages FIRST (pad /
-    inert rows land on the null page), then reads back through the page
-    tables — ``gather`` reconstitutes the contiguous timelines
-    (exact-semantics default), ``kernel`` runs the ragged prefill Pallas
-    kernel (ops/paged_attention.py) against the pool in place. Distinct
-    live slots never share a page, so rows are independent.
-
-    → (last-valid-token logits [N, V] fp32 if return_logits else None,
-    updated pool).
-    """
-    if attn_impl not in ("gather", "kernel"):
-        raise ValueError(
-            f"attn_impl must be gather|kernel, got {attn_impl!r}")
+def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
+                         offsets, n_valid, attn_impl: str):
+    """Shared chunk-row transformer body: write one [N, C] chunk batch
+    into the page pool at per-row arbitrary offsets and attend causally
+    over each slot's whole written prefix. Both chunked PREFILL
+    (`prefill_chunk_paged`) and speculative VERIFY
+    (`verify_chunk_paged`) lower through this one body — the verify
+    pass is structurally a chunked-prefill row, so sharing the body is
+    what makes the exactness argument (and the compile count) carry
+    over. → (hidden states [N, C, D], updated pool)."""
     N, C = tokens.shape
     ps = pool["k"].shape[2]
     x = params["wte"].astype(cfg.dtype)[tokens]            # [N, C, D]
@@ -220,7 +190,51 @@ def prefill_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (stacked, pool["k"], pool["v"]))
-    pool = {"k": new_k, "v": new_v}
+    return x, {"k": new_k, "v": new_v}
+
+
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("return_logits", "attn_impl"),
+                   donate_argnums=(3,))
+def prefill_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
+                        offsets, n_valid, *, return_logits: bool = True,
+                        attn_impl: str = "gather"):
+    """Write ONE chunk per slot of up to N prompts' KV pages, each at its
+    own arbitrary token offset (Sarathi/Orca-style chunked prefill, one
+    fused dispatch per scheduler tick).
+
+    The compile-count fix for prefill: N and C are engine constants
+    (n_slots × chunk size), `offsets`/`n_valid` are traced vectors, and
+    `tables` are full-width page tables — so every chunk of every prompt
+    length, at any batch occupancy, lowers the same program. Exactly two
+    distinct prefill compilations total (``return_logits`` False for
+    interior-only batches, True when any row carries a final chunk, which
+    alone pays the LM head), replacing the one-shot path's
+    buckets × admission-ladder grid.
+
+    tokens: [N, C] (row = slot; tail chunks padded); tables: [N,
+    max_pages] page ids (pages covering positions
+    ``offsets[i] .. offsets[i]+n_valid[i]-1`` must be allocated);
+    offsets: [N] — absolute position of tokens[i, 0]; n_valid: [N] —
+    valid tokens in row i's chunk (0 = inert row: all writes land on the
+    null page and its logits row is garbage the engine ignores).
+
+    Queries attend causally over everything their slot has written so
+    far: each layer scatters the batch's K/V into its pages FIRST (pad /
+    inert rows land on the null page), then reads back through the page
+    tables — ``gather`` reconstitutes the contiguous timelines
+    (exact-semantics default), ``kernel`` runs the ragged prefill Pallas
+    kernel (ops/paged_attention.py) against the pool in place. Distinct
+    live slots never share a page, so rows are independent.
+
+    → (last-valid-token logits [N, V] fp32 if return_logits else None,
+    updated pool).
+    """
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"attn_impl must be gather|kernel, got {attn_impl!r}")
+    x, pool = _chunk_paged_forward(cfg, params, tokens, pool, tables,
+                                   offsets, n_valid, attn_impl)
     if not return_logits:
         return None, pool
     logits = _head(params, cfg, x)                         # [N, C, V]
@@ -231,15 +245,50 @@ def prefill_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
     return last, pool
 
 
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("attn_impl",), donate_argnums=(3,))
+def verify_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
+                       offsets, n_valid, *, attn_impl: str = "gather"):
+    """Speculative-verify dispatch: score a [N, C] batch of rows
+    ``[pending, draft_1, ..., draft_{k}]`` (C = k+1) written at each
+    slot's decode cursor, returning the target's logits at EVERY chunk
+    position — row i's logits are the target distribution for the token
+    AFTER position offsets+i, which is exactly what rejection sampling
+    needs to accept/reject draft_{i+1}.
+
+    Same body as `prefill_chunk_paged` (`_chunk_paged_forward`): the
+    verify pass IS a chunked-prefill row — KV for the proposed tokens is
+    scattered at arbitrary offsets and causally masked within the chunk,
+    so the PR 4 chunk program (and its gather oracle) is the verify
+    program. Only the head differs: every position pays the LM head
+    (the k+1-wide full-logits head is the whole point — one weight pass
+    scores all proposals). The engine rolls rejected positions back by
+    rewinding cursors host-side; the garbage KV they leave behind sits
+    past every kv-length mask and is overwritten by the next write at
+    that position.
+
+    → (logits [N, C, V] fp32, updated pool).
+    """
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"attn_impl must be gather|kernel, got {attn_impl!r}")
+    x, pool = _chunk_paged_forward(cfg, params, tokens, pool, tables,
+                                   offsets, n_valid, attn_impl)
+    return _head(params, cfg, x), pool                     # [N, C, V]
+
+
 def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
-                       tables, attn_impl: str = "gather"):
+                       tables, attn_impl: str = "gather", write_mask=None):
     """All B slots advance one token against the page pool.
 
     tokens: [B]; positions: [B]; tables: [B, max_pages]; attn_impl
     (static): "gather" reconstitutes each slot's contiguous timeline
     [B, T, H, K] (T = max_pages × page_size) per layer — math identical
     to the dense `_decode_once`; "kernel" runs the Pallas ragged
-    paged-attention kernel against the pool in place.
+    paged-attention kernel against the pool in place. `write_mask`
+    ([B] bool, optional) routes masked rows' K/V writes to the null
+    page — the speculative draft loop uses it so proposal steps past a
+    slot's per-tick budget never touch real pages.
     → (logits [B, V] fp32, updated pool).
     """
     if attn_impl not in ("gather", "kernel"):
@@ -254,9 +303,14 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
     stacked = {k: params[k].astype(cfg.dtype) for k in _BLOCK_KEYS}
     scale = 1.0 / math.sqrt(cfg.head_dim)
     # Write target + kv length are loop-invariant across layers — computed
-    # once here, never inside the scan body.
+    # once here, never inside the scan body. The page index is clamped
+    # (like the chunk path) because a masked draft step's position can
+    # run past the table on a near-max-len slot.
     write_page = jnp.take_along_axis(
-        tables, (positions // ps)[:, None], axis=1)[:, 0]    # [B]
+        tables, jnp.minimum(positions // ps, tables.shape[1] - 1)[:, None],
+        axis=1)[:, 0]                                        # [B]
+    if write_mask is not None:
+        write_page = jnp.where(write_mask, write_page, 0)
     write_off = positions % ps                               # [B]
     kv_lengths = positions + 1                               # [B]
 
@@ -336,7 +390,73 @@ def decode_multi_paged(cfg: GPTConfig, params, tokens, pool, positions,
     return out, pool
 
 
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("k", "attn_impl", "need_probs"),
+                   donate_argnums=(3,))
+def spec_draft_propose(cfg: GPTConfig, params, tokens, pool, positions,
+                       tables, n_prop, temps, key, *, k: int,
+                       attn_impl: str = "gather", need_probs: bool = True):
+    """Fused speculative draft loop: k+1 draft decode steps with
+    on-device sampling against the DRAFT's page pool, sharing the
+    target's page tables (the draft owns no pages — its pool rows at
+    the same page ids mirror the target's token layout, so target-side
+    allocation, COW, prefix sharing, and rollback govern both).
+
+    Step 0 feeds each slot's pending token at its decode cursor
+    (`positions`); step i samples proposal d_i from the previous step's
+    logits and feeds it at cursor+i, writing the draft's K/V as it
+    goes. The scan runs ONE extra step (k+1 total) purely for its
+    write: it lands d_k's draft K/V at cursor+k, so after an
+    all-accepted tick the draft cursor still equals the target cursor
+    and the next tick needs no catch-up pass — the invariant that keeps
+    this whole loop a single fixed-shape dispatch per tick (one
+    program per (k, attn_impl, need_probs), no host round trips
+    inside).
+
+    tokens: [B] pending token per slot; positions: [B] decode cursor;
+    n_prop: [B] per-slot proposal budget (step i's write is routed to
+    the null page when i > n_prop[b]; -1 = fully inert row); temps: [B]
+    sampling temperature (0 = greedy argmax, matching decode_multi).
+
+    → (proposals [k, B] int32, draft probs [k, B, V] fp32 — the
+    temperature-scaled softmax row each proposal was sampled from,
+    exactly the q(x) rejection sampling divides by, or None when
+    ``need_probs`` is False — and the updated draft pool).
+
+    ``need_probs=False`` (an all-greedy tick, where acceptance is
+    argmax-chain matching and nothing reads q) drops the softmax +
+    [k, B, V] scan-stack from the program entirely — a second variant
+    per (k, attn_impl), the same two-variant bargain
+    prefill_chunk_paged strikes with ``return_logits``.
+    """
+
+    def step(carry, i):
+        toks, pos, pool, key = carry
+        logits, pool = _decode_once_paged(
+            cfg, params, toks, pool, pos, tables, attn_impl,
+            write_mask=i <= n_prop)
+        key, sub = jax.random.split(key)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = jax.random.categorical(sub, scaled, axis=-1)
+        nxt = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+        ys = (nxt, jax.nn.softmax(scaled, axis=-1)) if need_probs else nxt
+        return (nxt, pos + 1, pool, key), ys
+
+    carry0 = (tokens, positions, pool, key)
+    # The k+1th step exists only for its K/V write; its sampled token /
+    # probs row is the (k+1)th proposal nobody verifies.
+    if need_probs:
+        (_, _, pool, _), (toks_out, probs_out) = jax.lax.scan(
+            step, carry0, jnp.arange(k + 1))
+        return toks_out[:k], probs_out[:k], pool
+    (_, _, pool, _), toks_out = jax.lax.scan(
+        step, carry0, jnp.arange(k + 1))
+    return toks_out[:k], None, pool
+
+
 __all__ = [
     "init_paged_kv", "copy_pages", "prefill_batch_paged",
-    "prefill_chunk_paged", "decode_step_paged", "decode_multi_paged",
+    "prefill_chunk_paged", "verify_chunk_paged", "spec_draft_propose",
+    "decode_step_paged", "decode_multi_paged",
 ]

@@ -309,6 +309,17 @@ def paged_prefill_attention(
     )(tables, offsets, lengths, q, k_pool, v_pool)
 
 
+# Speculative-verify reuse: the verify pass of draft-model speculative
+# decoding (serve/llm.py) is structurally a ragged chunked-prefill row —
+# k+1 tokens (pending + k draft proposals) written at the slot's decode
+# cursor, causally masked WITHIN the chunk, attending every earlier page
+# through the same scalar-prefetched table. No new kernel exists or is
+# needed: the prefill kernel above (and its gather oracle below) IS the
+# verify kernel, with C = k+1, reached through the shared chunk body
+# (models/paged_kv._chunk_paged_forward); rejected proposals are rolled
+# back host-side by rewinding cursors (models/paged_kv.py
+# verify_chunk_paged documents why the garbage K/V they leave is inert).
+
 def reference_paged_attention(q, k_pool, v_pool, tables, lengths, *,
                               sm_scale=None):
     """Gather-semantics oracle: reconstitute each slot's contiguous

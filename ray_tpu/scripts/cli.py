@@ -211,7 +211,7 @@ def render_serve_status(history: bool = False,
             for key in ("queue_depth", "active_slots", "prefilling_slots",
                         "pool_pages_free", "pool_pages_total",
                         "prefill_budget_util", "ttft_ewma_ms",
-                        "decode_tok_s_ewma"):
+                        "decode_tok_s_ewma", "spec_accepted_per_step"):
                 if key in eng:
                     bits.append(f"{key}={eng[key]}")
             lines.append(f"    replica {r['replica']}: " + " ".join(bits))

@@ -63,6 +63,9 @@ _REPLICA_LOAD_GAUGES = {
         ("kv_pages_free", "Replica KV page-pool free at the last probe"),
         ("prefix_cache_hit_rate",
          "Replica prefix-cache hit rate at the last probe"),
+        ("spec_accepted_per_step",
+         "Replica speculative tokens-per-verify-step EWMA at the last "
+         "probe"),
     )
 }
 
@@ -362,6 +365,8 @@ class ServeController:
             "kv_pages_free": float(load.get("pool_pages_free", 0.0)),
             "prefix_cache_hit_rate": float(
                 load.get("prefix_cache_hit_rate", 0.0)),
+            "spec_accepted_per_step": float(
+                load.get("spec_accepted_per_step", 0.0)),
             "ts": s.get("ts", 0.0),
         }
 

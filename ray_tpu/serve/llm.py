@@ -103,6 +103,21 @@ _LOAD_GAUGES = {
          "KV pages currently pinned by prefix-cache entries"),
         ("prefix_cache_hit_rate",
          "Prefix-cache admission hit rate since last stats reset"),
+        ("spec_accepted_per_step",
+         "EWMA of tokens emitted per slot per speculative verify pass"),
+    )
+}
+
+# Speculative-decoding lifecycle counters: cumulative proposals vs
+# acceptances, flushed with the hosting worker's metrics like every
+# other serve counter — the acceptance RATE (the whole ballgame for the
+# speculative speedup) is derivable at /metrics from the two series.
+_SPEC_COUNTERS = {
+    name: _profiling.Counter(
+        f"llm_spec_{name}_total", description=desc, tag_keys=("replica",))
+    for name, desc in (
+        ("proposed", "Draft tokens proposed to speculative verification"),
+        ("accepted", "Draft proposals the target model accepted"),
     )
 }
 
@@ -161,6 +176,69 @@ def _ring_pctls(ring) -> tuple[float, float]:
     s = sorted(ring)
     return (round(s[len(s) // 2], 3),
             round(s[max(0, math.ceil(len(s) * 0.95) - 1)], 3))
+
+
+def _softmax_f64(row: np.ndarray) -> np.ndarray:
+    z = row.astype(np.float64)
+    z -= z.max()
+    e = np.exp(z)
+    return e / e.sum()
+
+
+def spec_accept_tokens(rng, temperature: float, proposals, draft_probs,
+                       verify_logits, n_prop: int, *,
+                       verify_argmax=None) -> tuple[list[int], int]:
+    """Speculative rejection sampling for ONE slot (Leviathan-style):
+    accept draft proposal x_i with probability min(1, p_i(x_i) /
+    q_i(x_i)); on the first rejection emit one sample from the residual
+    distribution norm(max(p_i − q_i, 0)); after n_prop straight
+    acceptances emit a bonus token from the target's next-position
+    distribution. The emitted marginal at every position is EXACTLY the
+    target distribution p, for any proposal distribution q — the
+    correctness argument the distributional test pins.
+
+    Greedy (temperature 0) degenerates to argmax-chain matching: every
+    emitted token is the argmax of the target's own logits at its
+    position, so the stream is byte-identical to non-speculative greedy
+    decode by construction, however bad the draft is.
+
+    proposals: [>= n_prop] draft tokens; draft_probs: [>= n_prop, V] the
+    temperature-scaled distributions they were actually sampled from
+    (q); verify_logits: [>= n_prop+1, V] target logits, row i scoring
+    the token after chunk position i; n_prop: proposals to consider;
+    verify_argmax: optional [>= n_prop+1] precomputed per-row argmax —
+    the greedy branch needs nothing else, so an all-greedy tick can
+    skip the full-logits device->host copy and pass only this.
+    → (emitted tokens, length 1..n_prop+1; accepted proposal count)."""
+    emitted: list[int] = []
+    if temperature == 0.0:
+        if verify_argmax is None:
+            verify_argmax = [int(np.argmax(verify_logits[i]))
+                             for i in range(n_prop + 1)]
+        for i in range(n_prop):
+            tgt = int(verify_argmax[i])
+            emitted.append(tgt)
+            if int(proposals[i]) != tgt:
+                return emitted, i
+        emitted.append(int(verify_argmax[n_prop]))
+        return emitted, n_prop
+    for i in range(n_prop):
+        x = int(proposals[i])
+        p = _softmax_f64(verify_logits[i] / temperature)
+        q = draft_probs[i].astype(np.float64)
+        if rng.random() * max(float(q[x]), 1e-30) < float(p[x]):
+            emitted.append(x)
+            continue
+        resid = np.maximum(p - q, 0.0)
+        z = resid.sum()
+        # A vanishing residual means p ≈ q, where acceptance is ~certain
+        # anyway — falling back to p keeps the marginal exact.
+        pr = resid / z if z > 1e-12 else p
+        emitted.append(int(rng.choice(len(pr), p=pr)))
+        return emitted, i
+    p = _softmax_f64(verify_logits[n_prop] / temperature)
+    emitted.append(int(rng.choice(len(p), p=p)))
+    return emitted, n_prop
 
 
 @dataclasses.dataclass
@@ -224,7 +302,9 @@ class LLMEngine:
                  prefill_chunk: int | None = None,
                  prefill_token_budget: int | None = None,
                  prefix_cache: bool | None = None,
-                 prefix_cache_pages: int | None = None):
+                 prefix_cache_pages: int | None = None,
+                 spec_draft=None, spec_k: int | None = None,
+                 spec_draft_params=None):
         import types
 
         import jax
@@ -262,6 +342,10 @@ class LLMEngine:
             decode_multi_paged=_w(_paged.decode_multi_paged,
                                   "decode_multi_paged"),
             copy_pages=_w(_paged.copy_pages, "copy_pages"),
+            verify_chunk_paged=_w(_paged.verify_chunk_paged,
+                                  "verify_chunk_paged"),
+            spec_draft_propose=_w(_paged.spec_draft_propose,
+                                  "spec_draft_propose"),
         )
         self.cfg = cfg
         self.n_slots = n_slots
@@ -277,9 +361,11 @@ class LLMEngine:
             cfg, jax.random.key(seed))
         chunk_explicit = prefill_chunk is not None
         cache_explicit = prefix_cache is not None
+        spec_explicit = spec_draft is not None
         if (kv_mode is None or page_size is None or attn_impl is None
                 or prefill_chunk is None or prefill_token_budget is None
-                or prefix_cache is None or prefix_cache_pages is None):
+                or prefix_cache is None or prefix_cache_pages is None
+                or spec_draft is None or spec_k is None):
             from ray_tpu.core.config import runtime_config
 
             _rc = runtime_config()
@@ -298,6 +384,9 @@ class LLMEngine:
             prefix_cache_pages = (
                 _rc.llm_prefix_cache_pages if prefix_cache_pages is None
                 else prefix_cache_pages)
+            spec_draft = (_rc.llm_spec_draft if spec_draft is None
+                          else spec_draft)
+            spec_k = _rc.llm_spec_k if spec_k is None else spec_k
         if prefill_chunk and kv_mode != "paged" and not chunk_explicit:
             # The global llm_prefill_chunk knob applies to paged engines;
             # a dense engine alongside it just keeps one-shot admission
@@ -341,6 +430,45 @@ class LLMEngine:
             raise ValueError(
                 f"prefill_token_budget ({prefill_token_budget}) must be 0 "
                 f"(pure-decode ticks) or >= prefill_chunk ({prefill_chunk})")
+        # Speculative decoding (config-validation pattern from
+        # llm_prefill_chunk): the verify program IS the chunked-prefill
+        # program, so spec rides the paged+chunked engine only. The
+        # GLOBAL knob alongside an incompatible engine soft-disables; an
+        # explicit constructor arg errors with the typed message.
+        draft_cfg = None
+        if spec_draft and not (kv_mode == "paged" and prefill_chunk):
+            if spec_explicit:
+                raise ValueError(
+                    "speculative decoding requires kv_mode='paged' AND "
+                    "prefill_chunk > 0 (the verify pass is a chunked-"
+                    f"prefill row); got kv_mode={kv_mode!r}, "
+                    f"prefill_chunk={prefill_chunk}")
+            spec_draft = ""
+        if spec_draft_params is not None and not spec_draft:
+            # Weights were supplied (a checkpoint was read off disk) but
+            # nothing enables speculation — serving non-speculatively
+            # here would silently discard them, with only a missing
+            # spec_accepted_per_step metric as a hint.
+            raise ValueError(
+                "spec_draft_params supplied but speculative decoding is "
+                "not enabled — set spec_draft / llm_spec_draft (and note "
+                "the global knob soft-disables on non-paged/non-chunked "
+                "engines)")
+        if spec_draft:
+            if spec_k < 1:
+                raise ValueError(
+                    f"llm_spec_k must be >= 1 (tokens the draft proposes "
+                    f"per slot per tick), got {spec_k}")
+            draft_cfg = (spec_draft if isinstance(spec_draft, gpt.GPTConfig)
+                         else gpt.GPTConfig.by_name(spec_draft))
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                # Proposals index the target distribution by token id;
+                # mismatched vocabs would silently verify garbage.
+                raise ValueError(
+                    "speculative draft/target vocab mismatch: draft "
+                    f"vocab_size {draft_cfg.vocab_size} != target "
+                    f"vocab_size {cfg.vocab_size} (the tokenizer must be "
+                    "tied)")
         self.kv_mode = kv_mode
         # Paged-decode attention path (models/paged_kv.py): "kernel" = the
         # Pallas ragged paged-attention kernel, "gather" = the exact-match
@@ -388,6 +516,34 @@ class LLMEngine:
             self._min_free_pages = n_pages
         else:
             self.cache = init_kv_cache(cfg, n_slots, max_len)
+        # Speculative decoding: the draft model keeps its OWN page pool
+        # (shaped to the draft config) but shares the target's page
+        # TABLES and cursors — draft pool row p mirrors target pool row
+        # p token-for-token (prefill chunks, decode writes, and COW
+        # copies are all mirrored), so target-side page accounting,
+        # prefix sharing, and rollback govern both pools and the draft
+        # never holds a reference of its own.
+        self.spec_k = int(spec_k) if spec_draft else 0
+        self.spec_draft_name = (
+            spec_draft if isinstance(spec_draft, str)
+            else "custom" if spec_draft else "")
+        self.draft_cfg = draft_cfg if spec_draft else None
+        self.draft_params = None
+        self.draft_cache = None
+        if spec_draft:
+            from ray_tpu.models.paged_kv import init_paged_kv
+
+            self.draft_params = (
+                spec_draft_params if spec_draft_params is not None
+                else gpt.init_params(draft_cfg, jax.random.key(seed + 1)))
+            self.draft_cache = init_paged_kv(
+                draft_cfg, self.n_pages, self.page_size)
+            # Acceptance draws (temperature>0 rejection sampling) come
+            # from a host-side generator: they gate host control flow
+            # (emit / rollback), so deviceifying them buys nothing.
+            self._spec_rng = np.random.default_rng(seed)
+        self._spec_accept_ewma: float | None = None
+        self._spec_span_seq = 0
         # Prefix cache (serve/prefix_cache.py): refcounted COW page
         # sharing across requests — admission binds the longest cached
         # chunk-aligned prefix and chunked prefill starts at the first
@@ -495,7 +651,15 @@ class LLMEngine:
                       # Prefix-cache lifecycle (zeros unless enabled).
                       "prefix_hits": 0, "prefix_misses": 0,
                       "prefix_evictions": 0, "cow_copies": 0,
-                      "prefix_cached_tokens": 0}
+                      "prefix_cached_tokens": 0,
+                      # Speculative decoding (zeros unless enabled):
+                      # proposed/accepted draft tokens, verify passes
+                      # (ticks × nothing — one per tick), per-slot verify
+                      # steps, and tokens actually emitted through the
+                      # accept path (accepted + correction/bonus).
+                      "spec_proposed": 0, "spec_accepted": 0,
+                      "spec_ticks": 0, "spec_slot_steps": 0,
+                      "spec_emitted": 0}
 
     # ------------------------------------------------------------- API
 
@@ -519,6 +683,12 @@ class LLMEngine:
         # would never build a chunk row and wedge its slot forever.
         if not prompt_ids:
             raise ValueError("prompt_ids must be non-empty")
+        if temperature < 0.0:
+            # Every sampling path branches on "0 = greedy, >0 = sample";
+            # a negative value would invert the softmax on some paths
+            # and be treated as greedy on others (the on-device draft
+            # loop clamps at <= 0) — reject it at the boundary.
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
         generated = [int(t) for t in (generated_ids or [])]
         context = list(prompt_ids) + generated
         too_big = (len(context) > self._prompt_cap
@@ -712,6 +882,7 @@ class LLMEngine:
             self._ttft_ewma_ms = None
             self._decode_ewma_tok_s = None
             self._budget_util_ewma = None
+            self._spec_accept_ewma = None
             if self.kv_mode == "paged":
                 self._min_free_pages = len(self.free_pages)
 
@@ -741,28 +912,43 @@ class LLMEngine:
 
     def _observe_window(self, t0: float, end: float, k: int, n_active: int,
                         tick_prefill: bool) -> None:
-        """Per-decode-window accounting: engine stats, the bounded
-        per-token step-time ring behind metrics()'s p50/p95, the
+        """Per-decode-window accounting for the NON-speculative window:
+        every slot advances exactly k tokens, so tokens-per-slot = k,
+        emitted = k × n_active, and the cap is k per slot."""
+        self._observe_decode(t0, end, float(k), k * n_active,
+                             k * self.n_slots, tick_prefill)
+
+    def _observe_decode(self, t0: float, end: float, per_slot: float,
+                        emitted: int, cap: int,
+                        tick_prefill: bool) -> None:
+        """Shared decode-tick accounting (non-speculative window AND
+        speculative propose/verify tick — one implementation so the
+        bookkeeping can't diverge across the spec knob): engine stats,
+        the bounded per-slot-token step-time ring behind metrics()'s
+        p50/p95 (tick wall / tokens each slot advanced — the
+        roofline-facing ms-per-weight-pass-per-token number), the
         step-latency histogram that makes kernel-vs-gather runs
         distinguishable at /metrics — and, for ticks that also ran
         prefill, the window-end-to-window-end interference ring (the
-        decode stall the prefill token budget bounds)."""
+        decode stall the prefill token budget bounds). `cap` is the
+        tick's max emittable tokens (slot_occupancy's denominator)."""
         dt = end - t0
         tags = self._impl_tags()
         with self._lock:
             self.stats["decode_time_s"] += dt
             self.stats["decode_windows"] += 1
-            self.stats["slot_step_sum"] += k * n_active
-            self.stats["slot_cap_sum"] += k * self.n_slots
-            self._step_ms.append(dt / k * 1000.0)
+            self.stats["slot_step_sum"] += emitted
+            self.stats["slot_cap_sum"] += cap
+            self._step_ms.append(dt / max(1.0, per_slot) * 1000.0)
             if dt > 0:
                 self._decode_ewma_tok_s = self._ewma(
-                    self._decode_ewma_tok_s, k * n_active / dt)
+                    self._decode_ewma_tok_s, emitted / dt)
             if tick_prefill and self._last_window_end is not None:
                 self._burst_step_ms.append(
-                    (end - self._last_window_end) / k * 1000.0)
+                    (end - self._last_window_end) / max(1.0, per_slot)
+                    * 1000.0)
             self._last_window_end = end
-        _DECODE_STEP_HIST.observe(dt / k, tags=tags)
+        _DECODE_STEP_HIST.observe(dt / max(1.0, per_slot), tags=tags)
 
     def metrics(self) -> dict:
         with self._lock:
@@ -780,6 +966,19 @@ class LLMEngine:
                 m["prefill_chunk"] = self.prefill_chunk
                 m["prefill_token_budget"] = self.prefill_budget
                 m["prefilling_slots"] = len(self._prefilling)
+            if self.spec_k:
+                m["spec_k"] = self.spec_k
+                m["spec_draft"] = self.spec_draft_name
+                if m["spec_slot_steps"]:
+                    # Tokens emitted per slot per verify pass (accepted
+                    # proposals + the always-emitted correction/bonus):
+                    # the speculative speedup headline — 1.0 is the
+                    # non-speculative rate, k+1 the ceiling.
+                    m["spec_accepted_per_step"] = round(
+                        m["spec_emitted"] / m["spec_slot_steps"], 4)
+                if m["spec_proposed"]:
+                    m["spec_accept_rate"] = round(
+                        m["spec_accepted"] / m["spec_proposed"], 4)
             if self.prefix_cache is not None:
                 m["prefix_cache"] = True
                 m["prefix_cache_entries"] = len(self.prefix_cache.entries)
@@ -866,6 +1065,15 @@ class LLMEngine:
                 if self._budget_util_ewma is not None:
                     snap["prefill_budget_util"] = round(
                         self._budget_util_ewma, 4)
+            if self.spec_k:
+                # Rides the PR 6 chain as-is: Replica.stats() →
+                # controller reconcile probe → serve.status() /
+                # /api/serve/load / `ray_tpu status --serve`, plus the
+                # llm_spec_accepted_per_step gauge set below.
+                snap["spec_k"] = self.spec_k
+                if self._spec_accept_ewma is not None:
+                    snap["spec_accepted_per_step"] = round(
+                        self._spec_accept_ewma, 4)
             if self.prefix_cache is not None:
                 # Cached-pages + hit-rate ride the same probe chain as
                 # the rest of the load snapshot: Replica.stats() →
@@ -1277,6 +1485,13 @@ class LLMEngine:
             dst[i] = d
         self.cache = rt.copy_pages(
             self.cache, rt.jnp.asarray(src), rt.jnp.asarray(dst))
+        if self.spec_k:
+            # Mirror the COW into the draft pool: the shared-table
+            # invariant (draft page p ≡ target page p, token-for-token)
+            # must survive divergence copies, or a warm bind's partial
+            # tail page would feed the draft stale K/V.
+            self.draft_cache = rt.copy_pages(
+                self.draft_cache, rt.jnp.asarray(src), rt.jnp.asarray(dst))
 
     def _prefill_group(self, bucket, group, slots) -> None:
         """One-shot admission: whole-prompt prefill for a same-bucket
@@ -1453,6 +1668,19 @@ class LLMEngine:
                 rt.jnp.asarray(tables), rt.jnp.asarray(offsets),
                 rt.jnp.asarray(valid),
                 return_logits=any_final, attn_impl=self.attn_impl)
+            if self.spec_k:
+                # Draft prefill mirror: the same chunk rows through the
+                # draft model into the draft pool (same tables/offsets),
+                # so a slot graduates with draft cursor == target cursor
+                # and the propose loop never needs a catch-up pass. The
+                # draft's graduation logits are unused (propose feeds the
+                # pending token itself), so this is always the cheaper
+                # no-head program.
+                _none, self.draft_cache = rt.prefill_chunk_paged(
+                    self.draft_cfg, self.draft_params, rt.jnp.asarray(toks),
+                    self.draft_cache, rt.jnp.asarray(tables),
+                    rt.jnp.asarray(offsets), rt.jnp.asarray(valid),
+                    return_logits=False, attn_impl=self.attn_impl)
             if any_final:
                 last = np.asarray(last)
         except Exception as e:
@@ -1574,28 +1802,32 @@ class LLMEngine:
                                 s, int(self.positions[s]) + kk - 1):
                             raise RuntimeError("page fit desync")
                     return active, kk
-            reclaim = [s for s in self._prefilling
-                       if int(self.slot_n_pages[s])]
-            if reclaim:
-                # Chunked over-admission can drain the pool into
-                # mid-prefill slots that `active` can't see; reclaim from
-                # the YOUNGEST page-holding one (zero sunk decode work,
-                # pure recompute; a slot admitted but not yet chunked
-                # holds nothing worth requeueing for) before touching any
-                # decode-active slot — one-shot admission could never
-                # starve decode this way.
-                self._preempt(reclaim[-1])
-                continue
-            if len(active) == 1:
-                # Sole survivor and the pool still can't cover one token:
-                # the request plus pool are simply too big — finish it.
-                self._finish_capacity(active[0])
-                return [], 0
-            victim = max(active, key=lambda s: self.slot_req[s].max_tokens
-                         - len(self.slot_req[s].out_ids))
-            active = [s for s in active if s != victim]
-            self._preempt(victim)
+            active = self._shed_for_pages(active)
         return [], 0
+
+    def _shed_for_pages(self, active: list[int]) -> list[int]:
+        """Pressure-relief tail shared by the decode-window and
+        speculative page fitters (one implementation so the two engines
+        can't diverge under pool pressure), in fixed order: reclaim the
+        YOUNGEST page-holding mid-prefill slot first (chunked
+        over-admission can drain the pool into slots `active` can't
+        see; zero sunk decode work, pure recompute — a slot admitted
+        but not yet chunked holds nothing worth requeueing for); then,
+        if a sole survivor still can't fit, the request plus pool are
+        simply too big — finish it; else preempt the decode victim with
+        the most remaining budget. → surviving active slots."""
+        reclaim = [s for s in self._prefilling
+                   if int(self.slot_n_pages[s])]
+        if reclaim:
+            self._preempt(reclaim[-1])
+            return active
+        if len(active) == 1:
+            self._finish_capacity(active[0])
+            return []
+        victim = max(active, key=lambda s: self.slot_req[s].max_tokens
+                     - len(self.slot_req[s].out_ids))
+        self._preempt(victim)
+        return [s for s in active if s != victim]
 
     def _finish_capacity(self, slot: int) -> None:
         """Slot exhausted the cache: finish early rather than overflow."""
@@ -1624,6 +1856,246 @@ class LLMEngine:
             if k <= bound:
                 return k
         return 1
+
+    # --------------------------------------------- speculative decoding
+
+    def _decode_table_view(self, active: list[int]) -> np.ndarray:
+        """Page-table view for a decode/propose/verify dispatch.
+
+        Ragged-attention win: slice the table to the widest ACTIVE slot
+        (next power of two bounds compile count), so attention
+        gathers/reads scale with the pages actually in use, not max_len.
+        Mid-prefill slots don't count: their rows are zeroed in a COPY so
+        their window writes land on the null page instead of corrupting
+        the pages their chunks already filled (and a long prompt
+        mid-prefill never widens — and re-compiles — every window while
+        it streams in)."""
+        w = max(1, int(self.slot_n_pages[active].max()))
+        width = 1
+        while width < w:
+            width *= 2
+        width = min(width, self.max_pages_per_slot)
+        view = self.page_table[:, :width]
+        if self._prefilling:
+            view = view.copy()
+            view[self._prefilling] = 0
+        return view
+
+    def _spec_span(self):
+        """Tracing span for 1-in-N verify dispatches (first always) —
+        same sampling rationale as _window_span: visible llm.spec_verify
+        spans in /api/traces without a per-tick root-trace flood."""
+        seq, self._spec_span_seq = self._spec_span_seq, self._spec_span_seq + 1
+        if seq % self._SPAN_SAMPLE == 0:
+            return tracing.start_span("llm.spec_verify", cat="serve_llm")
+        return contextlib.nullcontext()
+
+    def _fit_spec_pages(self, active: list[int], k_map: dict) -> list[int]:
+        """Paged fit for the speculative window: grow every active slot
+        to cover its verify writes (cursor .. cursor + k_i). Pressure
+        order mirrors _fit_window_pages (cached pages are speculative
+        value, a live window is not): zero-active prefix-cache entries
+        are reclaimed at each rung FIRST, then the proposal budget
+        degrades (k_i → 1 → 0; a 0-proposal tick is a plain one-token
+        verify, i.e. ordinary decode), then mid-prefill slots are
+        reclaimed, then a decode victim preempted (the shared
+        _shed_for_pages tail)."""
+        while active:
+            for shrink in (None, 1, 0):
+                ext = {s: (k_map[s] if shrink is None
+                           else min(k_map[s], shrink)) for s in active}
+                extra = sum(
+                    max(0, self._pages_for(int(self.positions[s]) + ext[s])
+                        - int(self.slot_n_pages[s]))
+                    for s in active)
+                if extra > len(self.free_pages):
+                    self._cache_reclaim(extra)
+                if extra <= len(self.free_pages):
+                    for s in active:
+                        k_map[s] = ext[s]
+                        if not self._grow_slot(
+                                s, int(self.positions[s]) + ext[s]):
+                            raise RuntimeError("page fit desync")
+                    return active
+            active = self._shed_for_pages(active)
+        return []
+
+    def _rollback_spec_pages(self, slots: list[int]) -> None:
+        """Batched rollback of rejected proposals' pages: ONE masked
+        vectorized cursor/table update covering every surviving slot
+        (the host-side twin of copy_pages' fused pow-2 pair batching)
+        instead of per-slot python writes — rollback runs on the shared
+        path every tick, so per-slot loops would tax accepted tokens
+        too. Pages past a slot's rolled-back cursor were grown
+        exclusively for this window (shared prefix-cache pages always
+        sit below the cursor), so dropping one reference frees them and
+        the pool never leaks partially-verified KV."""
+        if not slots:
+            return
+        rows = np.asarray(slots, np.int64)
+        keep = (self.positions[rows] - 1) // self.page_size + 1
+        have = self.slot_n_pages[rows]
+        cols = np.arange(self.max_pages_per_slot)[None, :]
+        drop = (cols >= keep[:, None]) & (cols < have[:, None])
+        if drop.any():
+            tbl = self.page_table[rows]
+            dropped = tbl[drop]
+            self.page_refs[dropped] -= 1
+            freed = dropped[self.page_refs[dropped] <= 0]
+            self.page_refs[freed] = 0
+            self.free_pages.extend(int(p) for p in freed)
+            tbl[drop] = 0
+            self.page_table[rows] = tbl
+            self.slot_n_pages[rows] = np.minimum(have, keep)
+
+    def _spec_decode_window(self, active: list[int],
+                            tick_prefill: bool) -> int:
+        """One speculative tick for every decode-ready slot: the draft
+        proposes up to spec_k tokens per slot in ONE fused on-device
+        loop (models/paged_kv.spec_draft_propose — k+1 draft steps, no
+        host round trips inside), the target scores all k+1 positions in
+        ONE batched chunked-prefill verify pass (verify_chunk_paged),
+        rejection sampling accepts a prefix of the proposals plus the
+        correction/bonus token, and the rejected tail's pages are rolled
+        back in one batched cursor update. → slots that did decode work.
+        """
+        rt = self._rt
+        jnp = rt.jnp
+        k = self.spec_k
+        survivors = []
+        for slot in active:
+            if self.positions[slot] + 1 >= self.max_len:
+                self._finish_capacity(slot)
+            else:
+                survivors.append(slot)
+        active = survivors
+        if not active:
+            self._last_window_end = None
+            return 0
+        # Per-slot proposal budget: never past the request's remaining
+        # output budget (− 1: the verify pass itself always emits one
+        # token beyond the accepted proposals) or the KV capacity. 0 is
+        # legal — the tick degenerates to a one-token verify (= decode)
+        # but still dispatches the full fixed-shape propose/verify pair:
+        # a per-k_eff program variant would trade the bounded compile
+        # count (ONE program per (k, width)) for savings that are
+        # negligible where spec belongs — a (k+1)-wide verify costs
+        # ≈ a 1-wide pass on a weight-bound decode, and the masked
+        # draft steps are ~k/(draft weight ratio) of a target pass.
+        k_map = {
+            s: max(0, min(k,
+                          self.slot_req[s].max_tokens
+                          - len(self.slot_req[s].out_ids) - 1,
+                          self.max_len - 1 - int(self.positions[s])))
+            for s in active}
+        active = self._fit_spec_pages(active, k_map)
+        if not active:
+            self._last_window_end = None
+            return 0
+        table_view = self._decode_table_view(active)
+        n_prop = np.full(self.n_slots, -1, np.int32)
+        for slot in active:
+            n_prop[slot] = k_map[slot]
+        t0 = time.perf_counter()
+        self._rng_key, sub = rt.jax.random.split(self._rng_key)
+        # Full distributions are only read by the temperature>0
+        # rejection-sampling branch: the draft's q, and the target's
+        # verify logits (greedy acceptance is argmax-chain matching).
+        # When every active slot is greedy — the common serving case —
+        # the draft never materializes its [k, B, V] probs on device
+        # (need_probs=False program variant), and both [.., V]
+        # device->host copies (~14 MB/tick combined at OPT-1.3B vocab,
+        # k=4, B=8) are skipped in favor of the [B, k+1] argmax.
+        sampling = any(self.slot_req[s].temperature > 0.0 for s in active)
+        proposals, draft_probs, self.draft_cache = rt.spec_draft_propose(
+            self.draft_cfg, self.draft_params, jnp.asarray(self.tokens),
+            self.draft_cache, jnp.asarray(self.positions),
+            jnp.asarray(table_view), jnp.asarray(n_prop),
+            jnp.asarray(self.temps), sub, k=k, attn_impl=self.attn_impl,
+            need_probs=sampling)
+        proposals = np.asarray(proposals)                  # [k, B]
+        draft_probs = np.asarray(draft_probs) if sampling else None
+        # Verify rows: [pending, d_1 .. d_k] per slot, written at the
+        # slot's decode cursor; inert rows (mid-prefill / free slots)
+        # carry n_valid 0.
+        vtoks = np.zeros((self.n_slots, k + 1), np.int32)
+        vtoks[:, 0] = self.tokens
+        vtoks[:, 1:] = proposals.T
+        n_valid = np.where(n_prop >= 0, n_prop + 1, 0).astype(np.int32)
+        with self._spec_span():
+            logits, self.cache = rt.verify_chunk_paged(
+                self.cfg, self.params, jnp.asarray(vtoks), self.cache,
+                jnp.asarray(table_view), jnp.asarray(self.positions),
+                jnp.asarray(n_valid), attn_impl=self.attn_impl)
+            if sampling:
+                logits = np.asarray(logits)                # [B, k+1, V]
+                argmax = None
+            else:
+                argmax = np.asarray(jnp.argmax(logits, axis=-1))
+                logits = None                              # [B, k+1]
+        proposed = accepted = emitted_total = 0
+        survivors = []
+        for slot in active:
+            req = self.slot_req[slot]
+            ki = k_map[slot]
+            proposed += ki
+            emitted, j = spec_accept_tokens(
+                self._spec_rng, req.temperature, proposals[:, slot],
+                draft_probs[:, slot] if draft_probs is not None else None,
+                logits[slot] if logits is not None else None, ki,
+                verify_argmax=argmax[slot] if argmax is not None else None)
+            t = int(self.positions[slot])
+            e = 0
+            finished = False
+            for tok in emitted:
+                e += 1
+                if self._emit(req, tok):
+                    finished = True
+                    break
+            # Cursor after acceptance: every emitted token except the
+            # LAST has its KV written by the verify pass ([pending,
+            # d_1..d_ki] landed at t..t+ki); the last emitted token is
+            # the new pending token — exactly the non-speculative
+            # cursor/pending contract.
+            self.positions[slot] = t + e
+            accepted += min(j, e)
+            emitted_total += e
+            if finished:
+                # Insert-on-free donation reads positions[slot], which
+                # now covers exactly the emitted tokens — exported
+                # continuations and cache entries carry ONLY accepted
+                # tokens.
+                self._release(slot)
+            else:
+                self.tokens[slot] = emitted[e - 1]
+                survivors.append(slot)
+        self._rollback_spec_pages(survivors)
+        end = time.perf_counter()
+        per_slot = emitted_total / len(active)
+        # Cap = what this tick could have emitted: the FITTED per-slot
+        # budgets (k_map shrinks under pool/output pressure — the same
+        # way the non-spec path books its post-fit shrunk k), idle
+        # slots at the full k+1 like the non-spec window counts them.
+        cap = (sum(k_map[s] + 1 for s in active)
+               + (self.n_slots - len(active)) * (k + 1))
+        self._observe_decode(t0, end, per_slot, emitted_total, cap,
+                             tick_prefill)
+        tags = self._impl_tags()
+        with self._lock:
+            self.stats["spec_ticks"] += 1
+            self.stats["spec_slot_steps"] += len(active)
+            self.stats["spec_proposed"] += proposed
+            self.stats["spec_accepted"] += accepted
+            self.stats["spec_emitted"] += emitted_total
+            self._spec_accept_ewma = self._ewma(
+                self._spec_accept_ewma, per_slot)
+        if proposed:
+            _SPEC_COUNTERS["proposed"].inc(
+                float(proposed), tags={"replica": tags["replica"]})
+        if accepted:
+            _SPEC_COUNTERS["accepted"].inc(
+                float(accepted), tags={"replica": tags["replica"]})
+        return len(active)
 
     def step(self) -> int:
         """One engine tick: admit queued requests, spend the chunked-
@@ -1676,6 +2148,12 @@ class LLMEngine:
         # abruptly with decodes in flight — the scenario the cross-replica
         # failover path must make invisible to clients.
         _chaos.hit("llm.decode_window")
+        if self.spec_k:
+            # Speculative decoding replaces the fused decode window
+            # entirely: one draft propose dispatch + one batched verify
+            # per tick, emitting 1..k+1 tokens per slot.
+            return self._spec_decode_window(active, tick_prefill) \
+                + n_prefilling
         k = self._pick_window(active)
         table_view = None
         if self.kv_mode == "paged":
@@ -1683,27 +2161,7 @@ class LLMEngine:
             if not active:
                 self._last_window_end = None
                 return n_prefilling
-            # Ragged-attention win: slice the page table to the widest
-            # ACTIVE slot (next power of two bounds compile count), so
-            # attention gathers/reads scale with the pages actually in
-            # use, not max_len — a 64-token conversation reads 1/16th of
-            # the KV traffic a dense [B, T_max] cache streams per step.
-            # Mid-prefill slots don't count: their rows are zeroed out of
-            # the view below, so a long prompt mid-prefill must not widen
-            # (and re-compile) every decode window while it streams in.
-            w = max(1, int(self.slot_n_pages[active].max()))
-            width = 1
-            while width < w:
-                width *= 2
-            width = min(width, self.max_pages_per_slot)
-            table_view = self.page_table[:, :width]
-            if self._prefilling:
-                # The fused window walks EVERY slot's write cursor: zero
-                # the mid-prefill rows in a COPY so their window writes
-                # land on the null page instead of corrupting the pages
-                # their chunks already filled.
-                table_view = table_view.copy()
-                table_view[self._prefilling] = 0
+            table_view = self._decode_table_view(active)
         t0 = time.perf_counter()
         if k > 1:
             self._rng_key, sub = rt.jax.random.split(self._rng_key)
@@ -1812,6 +2270,7 @@ class LLMDeployment:
 
     def __init__(self, model: str = "tiny", *, n_slots: int = 8,
                  max_len: int = 1024, params_checkpoint: str | None = None,
+                 spec_draft_checkpoint: str | None = None,
                  engine_kwargs: dict | None = None,
                  jax_platform: str | None = None):
         if jax_platform is not None:
@@ -1824,13 +2283,29 @@ class LLMDeployment:
 
         cfg = gpt.GPTConfig.by_name(model)
         params = None
+        engine_kwargs = dict(engine_kwargs or {})
         if params_checkpoint:
             from ray_tpu.train.checkpoint import Checkpoint
 
             ck = Checkpoint.from_directory(params_checkpoint).to_dict()
             params = ck["params"]
+        if spec_draft_checkpoint:
+            # Trained draft weights for speculative decoding (the
+            # llm_spec_draft knob names the draft ARCHITECTURE; without
+            # a checkpoint the engine falls back to random draft init,
+            # whose ~zero acceptance makes every tick strictly slower
+            # than non-speculative decode).
+            if "spec_draft_params" in engine_kwargs:
+                raise ValueError(
+                    "spec_draft_checkpoint and"
+                    " engine_kwargs['spec_draft_params'] both name draft"
+                    " weights — pass exactly one")
+            from ray_tpu.train.checkpoint import Checkpoint
+
+            dck = Checkpoint.from_directory(spec_draft_checkpoint).to_dict()
+            engine_kwargs["spec_draft_params"] = dck["params"]
         self.engine = LLMEngine(cfg, params, n_slots=n_slots,
-                                max_len=max_len, **(engine_kwargs or {}))
+                                max_len=max_len, **engine_kwargs)
         self.engine.start()
 
     def generate(self, prompt_ids: list[int], max_tokens: int = 64,
