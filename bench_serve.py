@@ -41,6 +41,32 @@ def _resolve_draft_cfg(name, cfg):
     return gpt.GPTConfig.by_name(name)
 
 
+def _weight_bytes_per_device(params, tp):
+    """Weight bytes ONE device streams per decode step: params whose
+    partition rule names the tp axis count size/tp, replicated params
+    count in full. Decode is weight-bound (BENCH_SERVE.md roofline), so
+    this is the per-shard HBM-bytes-per-step numerator the tp ablation
+    pins — near-halving it at tp=2 is the whole point.
+
+    Untied configs exclude `wte`: decode only GATHERS B embedding rows
+    per step (the full table is never streamed), while the separate
+    `lm_head` does stream for the logits pass. Tied configs keep `wte`
+    — it IS the head matrix there."""
+    from ray_tpu.models import gpt, partition
+
+    specs = partition.match_partition_rules(gpt.partition_rules(), params)
+    total = 0
+    for name, leaf in params.items():
+        if name == "wte" and "lm_head" in params:
+            continue
+        sharded = any(
+            ax == "tp" or (isinstance(ax, tuple) and "tp" in ax)
+            for ax in specs[name])
+        total += (leaf.size * leaf.dtype.itemsize
+                  // (tp if sharded else 1))
+    return int(total)
+
+
 def _fit_periodic(cfg, params, pattern, steps):
     """Adam-fit `params` to continue the repeated `pattern` (the
     --repeat-period workload): rotations of the period tiled to one
@@ -149,6 +175,17 @@ def main() -> None:
                          " in one chunked verify pass")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per slot per tick")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel shards (llm_tp): params +"
+                         " KV pool shard along the head axis over a"
+                         " ('tp',) mesh and every paged program runs"
+                         " per-shard (models/partition.py). Requires"
+                         " --kv-mode paged and --prefill-chunk > 0."
+                         " Off-TPU the bench forces a host-device mesh"
+                         " of this size (tiny models), so the CPU"
+                         " ablation measures the per-device"
+                         " weight/KV-bytes-per-step split, not wall"
+                         " speedup — virtual devices share one core")
     ap.add_argument("--repeat-period", type=int, default=0,
                     help="repetitive workload: prompts are random-phase"
                          " rotations of one fixed token pattern of this"
@@ -276,6 +313,15 @@ def main() -> None:
         ap.error("--repeat-period must be >= 1")
     if args.spec_fit_steps and args.spec_fit_steps < 1:
         ap.error("--spec-fit-steps must be >= 1")
+    if args.tp < 1:
+        ap.error("--tp must be >= 1")
+    if args.tp > 1 and (args.kv_mode != "paged" or not args.prefill_chunk):
+        ap.error("--tp > 1 requires --kv-mode paged and"
+                 " --prefill-chunk > 0 (the sharded programs are the"
+                 " paged chunked set)")
+    if args.real_replicas and args.tp > 1:
+        ap.error("--tp drives the in-process engine only (replica"
+                 " processes size their own device mesh)")
     phases = None
     if args.ramp:
         try:
@@ -293,10 +339,13 @@ def main() -> None:
         return
 
     if args.model in ("tiny", "tiny25m"):
-        # CI path: force the CPU backend before jax initializes.
+        # CI path: force the CPU backend before jax initializes — with
+        # enough virtual host devices to carry the --tp mesh (the
+        # TESTING.md off-TPU repro: XLA_FLAGS=--xla_force_host_platform_
+        # device_count=N before the first backend touch).
         from ray_tpu.utils.platform import force_cpu_devices
 
-        force_cpu_devices(1)
+        force_cpu_devices(max(1, args.tp))
 
     from ray_tpu.models import gpt
     from ray_tpu.serve.llm import LLMEngine
@@ -369,7 +418,10 @@ def main() -> None:
                        prefix_cache=args.prefix_cache or None,
                        prefix_cache_pages=args.prefix_cache_pages,
                        spec_draft=draft_cfg, spec_k=args.spec_k,
-                       spec_draft_params=draft_params)
+                       spec_draft_params=draft_params,
+                       # Always explicit: the tp=1 ablation arm must pin
+                       # tp=1, not fall through to a stray RAY_TPU_LLM_TP.
+                       tp=args.tp)
     # Shared-prefix workload: a small pool of "system prompts" that a
     # fraction of every prompt is drawn from. Built up front so the
     # multiset is deterministic regardless of client scheduling.
@@ -552,6 +604,16 @@ def main() -> None:
         # Which attention implementation produced this row — kernel vs
         # gather ablations must be distinguishable from the JSON alone.
         row["llm_attn_impl"] = em.get("llm_attn_impl", engine.attn_impl)
+        # Sharding topology + the per-device bytes-per-step split the
+        # tp ablation pins (weights/TP + KV/TP; replicated weights —
+        # embeddings/norms/head — pay full freight on every shard).
+        import jax as _jax
+
+        row["llm_tp"] = engine.tp
+        row["n_devices"] = len(_jax.devices())
+        row["weight_bytes_per_device"] = _weight_bytes_per_device(
+            engine.params, engine.tp)
+        row["kv_bytes_per_device"] = engine._pool_shard_bytes()
     row["prefix_cache"] = bool(engine.prefix_cache is not None)
     if engine.prefix_cache is not None:
         # Warm-vs-cold TTFT split (client-observed AND engine-side): the

@@ -352,6 +352,21 @@ class Config:
     # verify chunk is k+1 tokens wide; each tick emits between 1 (first
     # proposal rejected) and k+1 (all accepted + bonus) tokens per slot.
     llm_spec_k: int = 4
+    # Tensor-parallel decode (models/partition.py): shards params
+    # (regex→PartitionSpec rules, gpt.partition_rules) and the paged KV
+    # pool along the HEAD axis over a ("tp",) mesh of local devices;
+    # every paged program runs per-shard via shard_map with only the
+    # per-layer attention-out/MLP-down psums crossing shards. 1 =
+    # single-chip engine, byte-for-byte. Requires kv_mode="paged" AND
+    # llm_prefill_chunk > 0; must divide n_heads and d_ff (target and
+    # draft) and fit the visible device count — on ANY misfit
+    # (incompatible engine, too few devices, non-divisor) the global
+    # knob soft-disables to 1 so a fleet-wide export can't crash a
+    # replica boot; explicit constructor args still raise typed errors,
+    # like llm_prefill_chunk. Off-TPU:
+    # XLA_FLAGS=--xla_force_host_platform_device_count=N forks virtual
+    # host devices (TESTING.md). Env: RAY_TPU_LLM_TP=2.
+    llm_tp: int = 1
 
     # --- flight recorder (compile watch + SLO monitor) ---
     # Recompile-storm alarm (ray_tpu/compile_watch.py): a structured

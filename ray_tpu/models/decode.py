@@ -50,13 +50,21 @@ def _qkv(h, layer, cfg):
     return q, k, v
 
 
-def _mlp(x, layer, cfg):
+def _mlp(x, layer, cfg, tp_axis=None):
+    """Feed-forward block. Under tensor parallelism (`tp_axis` set, the
+    body running inside a shard_map) w_up/b_up/w_down are sharded on the
+    hidden width: the up-projection and gelu are shard-local and the
+    down-projection yields a partial sum reduced across shards BEFORE
+    the replicated b_down joins the residual (each shard adding b_down
+    pre-psum would count it tp times)."""
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
     up = jax.nn.gelu(
         jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
         + layer["b_up"].astype(cfg.dtype))
-    return x + (jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(cfg.dtype))
-                + layer["b_down"].astype(cfg.dtype))
+    down = jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(cfg.dtype))
+    if tp_axis is not None:
+        down = jax.lax.psum(down, tp_axis)
+    return x + (down + layer["b_down"].astype(cfg.dtype))
 
 
 def _head(params, cfg, x):

@@ -168,6 +168,38 @@ def logical_axes(cfg: GPTConfig) -> dict[str, tuple]:
     return {k: v["axes"] for k, v in param_specs(cfg).items()}
 
 
+def partition_rules() -> tuple:
+    """Regex → PartitionSpec rule table for the stacked-block layout
+    (models/partition.py `match_partition_rules` — rules match the
+    ``/``-joined pytree path, first match wins).
+
+    Serving tensor parallelism shards along the axis decode already
+    parallelizes over: attention QKV on heads, the out projection on
+    its head input, the MLP on its hidden width — all "tp"; embeddings,
+    norms, biases on the embed axis, and the LM head stay replicated
+    (the per-position head matmul is one weight read per WINDOW, not
+    per layer, and replicating it keeps logits — and therefore argmax /
+    sampling — whole on every shard). Shapes per param_specs():
+    wq/wk/wv [L, D, H, K], wo [L, H, K, D], w_up [L, D, F],
+    b_up [L, F], w_down [L, F, D].
+    """
+    from jax.sharding import PartitionSpec
+
+    from ray_tpu.models.partition import TP_AXIS as TP
+
+    return (
+        (r"^w[qkv]$", PartitionSpec(None, None, TP, None)),
+        (r"^wo$", PartitionSpec(None, TP, None, None)),
+        (r"^w_up$", PartitionSpec(None, None, TP)),
+        (r"^b_up$", PartitionSpec(None, TP)),
+        (r"^w_down$", PartitionSpec(None, TP, None)),
+        # Replicated tail: embeddings, layer norms, residual-side biases,
+        # and the LM head (explicit entries — match_partition_rules
+        # treats an unmatched leaf as an error, not as replication).
+        (r"^(wte|lm_head|ln|b_down)", PartitionSpec()),
+    )
+
+
 def init_params(cfg: GPTConfig, rng: jax.Array) -> dict[str, jax.Array]:
     specs = param_specs(cfg)
     keys = jax.random.split(rng, len(specs))

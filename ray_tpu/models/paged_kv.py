@@ -130,7 +130,8 @@ def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
 
 
 def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
-                         offsets, n_valid, attn_impl: str):
+                         offsets, n_valid, attn_impl: str,
+                         tp_axis: str | None = None):
     """Shared chunk-row transformer body: write one [N, C] chunk batch
     into the page pool at per-row arbitrary offsets and attend causally
     over each slot's whole written prefix. Both chunked PREFILL
@@ -138,7 +139,10 @@ def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
     (`verify_chunk_paged`) lower through this one body — the verify
     pass is structurally a chunked-prefill row, so sharing the body is
     what makes the exactness argument (and the compile count) carry
-    over. → (hidden states [N, C, D], updated pool)."""
+    over. With `tp_axis` set (the body running inside a shard_map over
+    a head-sharded params/pool slice) everything is shard-local except
+    the attention-out and MLP-down partial sums, psum'd per layer.
+    → (hidden states [N, C, D], updated pool)."""
     N, C = tokens.shape
     ps = pool["k"].shape[2]
     x = params["wte"].astype(cfg.dtype)[tokens]            # [N, C, D]
@@ -166,10 +170,12 @@ def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
         # Write before attending (same order as the decode path): each
         # row then reads its own chunk's K/V back through its table, so
         # intra-chunk causality is just the tpos <= qpos mask.
+        # Head count from the array, not the config: under tensor
+        # parallelism this body sees the per-shard head slice.
         k_pool_l = k_pool_l.at[write_pages, write_offs].set(
-            k.reshape(N * C, cfg.n_heads, cfg.head_dim).astype(cfg.dtype))
+            k.reshape(N * C, *k.shape[2:]).astype(cfg.dtype))
         v_pool_l = v_pool_l.at[write_pages, write_offs].set(
-            v.reshape(N * C, cfg.n_heads, cfg.head_dim).astype(cfg.dtype))
+            v.reshape(N * C, *v.shape[2:]).astype(cfg.dtype))
         if attn_impl == "kernel":
             from ray_tpu.ops.paged_attention import paged_prefill_attention
 
@@ -183,9 +189,12 @@ def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
             attn = reference_paged_prefill_attention(
                 q, k_pool_l, v_pool_l, tables, offsets, kv_lens,
                 sm_scale=scale)
-        x = x + jnp.einsum("bchk,hkd->bcd", attn,
-                           layer["wo"].astype(cfg.dtype))
-        x = _mlp(x, layer, cfg)
+        attn_out = jnp.einsum("bchk,hkd->bcd", attn,
+                              layer["wo"].astype(cfg.dtype))
+        if tp_axis is not None:
+            attn_out = jax.lax.psum(attn_out, tp_axis)
+        x = x + attn_out
+        x = _mlp(x, layer, cfg, tp_axis=tp_axis)
         return x, (k_pool_l, v_pool_l)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -237,12 +246,7 @@ def prefill_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
                                    offsets, n_valid, attn_impl)
     if not return_logits:
         return None, pool
-    logits = _head(params, cfg, x)                         # [N, C, V]
-    last = jnp.take_along_axis(
-        logits,
-        jnp.maximum(n_valid - 1, 0)[:, None, None].astype(jnp.int32),
-        axis=1)[:, 0]                                      # [N, V]
-    return last, pool
+    return _last_valid_logits(cfg, params, x, n_valid), pool
 
 
 @functools.partial(jax.jit, static_argnums=(0,),
@@ -278,7 +282,8 @@ def verify_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
 
 
 def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
-                       tables, attn_impl: str = "gather", write_mask=None):
+                       tables, attn_impl: str = "gather", write_mask=None,
+                       tp_axis: str | None = None):
     """All B slots advance one token against the page pool.
 
     tokens: [B]; positions: [B]; tables: [B, max_pages]; attn_impl
@@ -288,7 +293,12 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
     paged-attention kernel against the pool in place. `write_mask`
     ([B] bool, optional) routes masked rows' K/V writes to the null
     page — the speculative draft loop uses it so proposal steps past a
-    slot's per-tick budget never touch real pages.
+    slot's per-tick budget never touch real pages. `tp_axis` (optional):
+    the tensor-parallel mesh axis when this body runs inside a
+    shard_map over head-sharded params and pool — both attention impls
+    read their per-shard pages unchanged (pages are indexed by id; only
+    the head dim is sliced) and the attention-out / MLP-down partial
+    sums psum across shards.
     → (logits [B, V] fp32, updated pool).
     """
     if attn_impl not in ("gather", "kernel"):
@@ -342,15 +352,93 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
             attn = reference_paged_attention(
                 q[:, 0], k_pool_l, v_pool_l, tables, kv_lengths,
                 sm_scale=scale)
-        x = x + jnp.einsum("bhk,hkd->bd", attn,
-                           layer["wo"].astype(cfg.dtype))[:, None, :]
-        x = _mlp(x, layer, cfg)
+        attn_out = jnp.einsum("bhk,hkd->bd", attn,
+                              layer["wo"].astype(cfg.dtype))
+        if tp_axis is not None:
+            attn_out = jax.lax.psum(attn_out, tp_axis)
+        x = x + attn_out[:, None, :]
+        x = _mlp(x, layer, cfg, tp_axis=tp_axis)
         return x, (k_pool_l, v_pool_l)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (stacked, pool["k"], pool["v"]))
     logits = _head(params, cfg, x)[:, 0]
     return logits, {"k": new_k, "v": new_v}
+
+
+def _sample_next(logits, temps, key):
+    """Shared on-device sampling step for every fused loop (decode
+    window + speculative draft, tp and non-tp twins alike): greedy
+    argmax at temp <= 0, else temperature-scaled categorical.
+    → (next tokens int32, scaled logits, advanced key)."""
+    key, sub = jax.random.split(key)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    greedy = jnp.argmax(logits, axis=-1)
+    sampled = jax.random.categorical(sub, scaled, axis=-1)
+    nxt = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+    return nxt, scaled, key
+
+
+def _last_valid_logits(cfg: GPTConfig, params, x, n_valid):
+    """Chunk-head epilogue shared by `prefill_chunk_paged` and its tp
+    twin: LM head over the chunk hiddens, then each row's logits at its
+    last VALID position (inert rows clamp to 0 — garbage the engine
+    ignores). → [N, V] fp32."""
+    logits = _head(params, cfg, x)                         # [N, C, V]
+    return jnp.take_along_axis(
+        logits,
+        jnp.maximum(n_valid - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]                                      # [N, V]
+
+
+def _decode_multi_scan(cfg: GPTConfig, params, tokens, pool, positions,
+                       tables, n_steps: int, temps, key, attn_impl: str,
+                       tp_axis: str | None = None):
+    """Shared fused-window scan body (`decode_multi_paged` runs it
+    directly; the tp twin runs it inside a shard_map with tp_axis set)
+    — ONE implementation so the sampling/cursor math cannot diverge
+    across the llm_tp knob."""
+
+    def step(carry, _):
+        toks, pos, pool, key = carry
+        logits, pool = _decode_once_paged(
+            cfg, params, toks, pool, pos, tables, attn_impl,
+            tp_axis=tp_axis)
+        nxt, _scaled, key = _sample_next(logits, temps, key)
+        return (nxt, pos + 1, pool, key), nxt
+
+    (_, _, pool, _), out = jax.lax.scan(
+        step, (tokens, positions, pool, key), None, length=n_steps)
+    return out, pool
+
+
+def _spec_propose_scan(cfg: GPTConfig, params, tokens, pool, positions,
+                       tables, n_prop, temps, key, k: int, attn_impl: str,
+                       need_probs: bool, tp_axis: str | None = None):
+    """Shared draft-propose scan body (`spec_draft_propose` runs it
+    directly; the tp twin inside a shard_map) — the k+1 masked decode
+    steps with on-device sampling. → (proposals [k, B], probs [k, B, V]
+    or None, updated pool)."""
+
+    def step(carry, i):
+        toks, pos, pool, key = carry
+        logits, pool = _decode_once_paged(
+            cfg, params, toks, pool, pos, tables, attn_impl,
+            write_mask=i <= n_prop, tp_axis=tp_axis)
+        nxt, scaled, key = _sample_next(logits, temps, key)
+        ys = (nxt, jax.nn.softmax(scaled, axis=-1)) if need_probs else nxt
+        return (nxt, pos + 1, pool, key), ys
+
+    carry0 = (tokens, positions, pool, key)
+    # The k+1th step exists only for its K/V write; its sampled token /
+    # probs row is the (k+1)th proposal nobody verifies.
+    if need_probs:
+        (_, _, pool, _), (toks_out, probs_out) = jax.lax.scan(
+            step, carry0, jnp.arange(k + 1))
+        return toks_out[:k], probs_out[:k], pool
+    (_, _, pool, _), toks_out = jax.lax.scan(
+        step, carry0, jnp.arange(k + 1))
+    return toks_out[:k], None, pool
 
 
 @functools.partial(jax.jit, static_argnums=(0,),
@@ -373,21 +461,8 @@ def decode_multi_paged(cfg: GPTConfig, params, tokens, pool, positions,
     covering positions + n_steps before dispatch, so tables are static
     across the window). → (tokens_out [n_steps, B] int32, updated pool).
     """
-
-    def step(carry, _):
-        toks, pos, pool, key = carry
-        logits, pool = _decode_once_paged(
-            cfg, params, toks, pool, pos, tables, attn_impl)
-        key, sub = jax.random.split(key)
-        greedy = jnp.argmax(logits, axis=-1)
-        sampled = jax.random.categorical(
-            sub, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
-        nxt = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
-        return (nxt, pos + 1, pool, key), nxt
-
-    (_, _, pool, _), out = jax.lax.scan(
-        step, (tokens, positions, pool, key), None, length=n_steps)
-    return out, pool
+    return _decode_multi_scan(cfg, params, tokens, pool, positions,
+                              tables, n_steps, temps, key, attn_impl)
 
 
 @functools.partial(jax.jit, static_argnums=(0,),
@@ -429,34 +504,226 @@ def spec_draft_propose(cfg: GPTConfig, params, tokens, pool, positions,
     per (k, attn_impl), the same two-variant bargain
     prefill_chunk_paged strikes with ``return_logits``.
     """
+    return _spec_propose_scan(cfg, params, tokens, pool, positions,
+                              tables, n_prop, temps, key, k, attn_impl,
+                              need_probs)
 
-    def step(carry, i):
-        toks, pos, pool, key = carry
-        logits, pool = _decode_once_paged(
-            cfg, params, toks, pool, pos, tables, attn_impl,
-            write_mask=i <= n_prop)
-        key, sub = jax.random.split(key)
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        greedy = jnp.argmax(logits, axis=-1)
-        sampled = jax.random.categorical(sub, scaled, axis=-1)
-        nxt = jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
-        ys = (nxt, jax.nn.softmax(scaled, axis=-1)) if need_probs else nxt
-        return (nxt, pos + 1, pool, key), ys
 
-    carry0 = (tokens, positions, pool, key)
-    # The k+1th step exists only for its K/V write; its sampled token /
-    # probs row is the (k+1)th proposal nobody verifies.
+# --------------------------------------------------------------------------
+# Tensor-parallel twins (llm_tp > 1): the SAME bodies as above, run
+# per-shard over a 1-axis ("tp",) mesh via utils/jax_compat.shard_map.
+# Params shard per models/gpt.py::partition_rules and the page pool
+# shards along its HEAD axis (KV_POOL_PARTITION_RULES below) — each
+# shard owns every page id for n_heads/tp heads, so page tables,
+# cursors, and the host-side allocator are shard-invariant and both
+# attention impls (including the Pallas kernels, which derive H from
+# the arrays) run unchanged on their slice. Only the per-layer
+# attention-out / MLP-down psums cross shards; logits, argmax, and
+# sampling are computed replicated. The engine binds `mesh` once at
+# init (functools.partial), so call sites are identical to the non-tp
+# dispatch table.
+# --------------------------------------------------------------------------
+
+# Pool pytree {"k": [L, P+1, ps, H, K], "v": ...} → heads (axis 3) shard
+# over tp. Lives here (not partition.py) because the pool layout is this
+# module's contract; the axis name comes from partition.TP_AXIS.
+def _kv_pool_partition_rules():
+    from jax.sharding import PartitionSpec
+
+    from ray_tpu.models.partition import TP_AXIS
+
+    return ((r"^(k|v)$",
+             PartitionSpec(None, None, None, TP_AXIS, None)),)
+
+
+KV_POOL_PARTITION_RULES = _kv_pool_partition_rules()
+
+
+def _tp_specs(params, pool):
+    """(param specs, pool specs, replicated spec) for one shard_map."""
+    from jax.sharding import PartitionSpec
+
+    from ray_tpu.models.gpt import partition_rules
+    from ray_tpu.models.partition import match_partition_rules
+
+    return (match_partition_rules(partition_rules(), params),
+            match_partition_rules(KV_POOL_PARTITION_RULES, pool),
+            PartitionSpec())
+
+
+def _smap(body, mesh, in_specs, out_specs):
+    """shard_map through the jax_compat shim. check_vma off: the bodies
+    hold Pallas calls and scans whose replication 0.4.x cannot infer;
+    replication of the PS() outputs is by construction (every shard
+    computes them from replicated operands)."""
+    from ray_tpu.utils.jax_compat import shard_map
+
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
+
+
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("mesh", "return_logits", "attn_impl"),
+                   donate_argnums=(3,))
+def prefill_chunk_paged_tp(cfg: GPTConfig, params, tokens, pool, tables,
+                           offsets, n_valid, *, mesh,
+                           return_logits: bool = True,
+                           attn_impl: str = "gather"):
+    """`prefill_chunk_paged` over a tp mesh: the chunk body runs
+    per-head-shard; the LM head (replicated weights, replicated hidden
+    states after the body's psums) runs outside the shard_map so the
+    logits row selection is identical to the single-shard program."""
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"attn_impl must be gather|kernel, got {attn_impl!r}")
+    pspecs, kvspecs, rep = _tp_specs(params, pool)
+
+    def body(params, tokens, pool, tables, offsets, n_valid):
+        return _chunk_paged_forward(cfg, params, tokens, pool, tables,
+                                    offsets, n_valid, attn_impl,
+                                    tp_axis="tp")
+
+    x, pool = _smap(body, mesh,
+                    (pspecs, rep, kvspecs, rep, rep, rep),
+                    (rep, kvspecs))(
+        params, tokens, pool, tables, offsets, n_valid)
+    if not return_logits:
+        return None, pool
+    return _last_valid_logits(cfg, params, x, n_valid), pool
+
+
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("mesh", "attn_impl"),
+                   donate_argnums=(3,))
+def verify_chunk_paged_tp(cfg: GPTConfig, params, tokens, pool, tables,
+                          offsets, n_valid, *, mesh,
+                          attn_impl: str = "gather"):
+    """`verify_chunk_paged` over a tp mesh (same body/head split as
+    `prefill_chunk_paged_tp`; every position pays the replicated head)."""
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"attn_impl must be gather|kernel, got {attn_impl!r}")
+    pspecs, kvspecs, rep = _tp_specs(params, pool)
+
+    def body(params, tokens, pool, tables, offsets, n_valid):
+        return _chunk_paged_forward(cfg, params, tokens, pool, tables,
+                                    offsets, n_valid, attn_impl,
+                                    tp_axis="tp")
+
+    x, pool = _smap(body, mesh,
+                    (pspecs, rep, kvspecs, rep, rep, rep),
+                    (rep, kvspecs))(
+        params, tokens, pool, tables, offsets, n_valid)
+    return _head(params, cfg, x), pool                     # [N, C, V]
+
+
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("mesh", "attn_impl"),
+                   donate_argnums=(3,))
+def decode_step_paged_tp(cfg: GPTConfig, params, tokens, pool, positions,
+                         tables, *, mesh, attn_impl: str = "gather"):
+    """`decode_step_paged` over a tp mesh. The head runs inside the
+    shard_map on replicated hidden states (deterministic → identical on
+    every shard), so the returned logits are replicated."""
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"attn_impl must be gather|kernel, got {attn_impl!r}")
+    pspecs, kvspecs, rep = _tp_specs(params, pool)
+
+    def body(params, tokens, pool, positions, tables):
+        return _decode_once_paged(cfg, params, tokens, pool, positions,
+                                  tables, attn_impl, tp_axis="tp")
+
+    return _smap(body, mesh,
+                 (pspecs, rep, kvspecs, rep, rep),
+                 (rep, kvspecs))(
+        params, tokens, pool, positions, tables)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 6),
+                   static_argnames=("mesh", "attn_impl"),
+                   donate_argnums=(3,))
+def decode_multi_paged_tp(cfg: GPTConfig, params, tokens, pool, positions,
+                          tables, n_steps: int, temps, key, *, mesh,
+                          attn_impl: str = "gather"):
+    """`decode_multi_paged` over a tp mesh: the whole fused window —
+    n_steps decode passes AND the on-device sampling — runs inside ONE
+    shard_map, so a window still costs one dispatch and one host
+    transfer. Sampling consumes replicated logits with a replicated key:
+    every shard draws the same token, the only cross-shard values being
+    the per-layer psums inside the decode body (`_decode_multi_scan` —
+    the non-tp program's own body, tp_axis threaded)."""
+    if attn_impl not in ("gather", "kernel"):
+        raise ValueError(
+            f"attn_impl must be gather|kernel, got {attn_impl!r}")
+    pspecs, kvspecs, rep = _tp_specs(params, pool)
+
+    def body(params, tokens, pool, positions, tables, temps, key):
+        return _decode_multi_scan(cfg, params, tokens, pool, positions,
+                                  tables, n_steps, temps, key, attn_impl,
+                                  tp_axis="tp")
+
+    return _smap(body, mesh,
+                 (pspecs, rep, kvspecs, rep, rep, rep, rep),
+                 (rep, kvspecs))(
+        params, tokens, pool, positions, tables, temps, key)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
+def copy_pages_tp(pool, src, dst, *, mesh):
+    """`copy_pages` over a tp mesh: page ids are shard-invariant and the
+    copy never touches the head axis, so each shard duplicates its own
+    head slice of the pages — COW semantics identical to single-shard."""
+    _, kvspecs, rep = _tp_specs({}, pool)
+
+    def body(pool, src, dst):
+        return {k: v.at[:, dst].set(v[:, src]) for k, v in pool.items()}
+
+    return _smap(body, mesh, (kvspecs, rep, rep), kvspecs)(pool, src, dst)
+
+
+@functools.partial(jax.jit, static_argnums=(0,),
+                   static_argnames=("k", "attn_impl", "need_probs", "mesh"),
+                   donate_argnums=(3,))
+def spec_draft_propose_tp(cfg: GPTConfig, params, tokens, pool, positions,
+                          tables, n_prop, temps, key, *, k: int, mesh,
+                          attn_impl: str = "gather",
+                          need_probs: bool = True):
+    """`spec_draft_propose` over a tp mesh: the fused k+1-step draft
+    loop (decode body + on-device sampling + budget write-masking —
+    `_spec_propose_scan`, the non-tp program's own body with tp_axis
+    threaded) runs inside one shard_map against the head-sharded DRAFT
+    pool, sharing the replicated target page tables. Proposals and
+    probs come back replicated; the draft pool stays sharded."""
+    pspecs, kvspecs, rep = _tp_specs(params, pool)
+
+    def body(params, tokens, pool, positions, tables, n_prop, temps, key):
+        toks_out, probs_out, pool = _spec_propose_scan(
+            cfg, params, tokens, pool, positions, tables, n_prop, temps,
+            key, k, attn_impl, need_probs, tp_axis="tp")
+        if need_probs:
+            return toks_out, probs_out, pool
+        return toks_out, pool       # probs_out is None: not a leaf for
+                                    # shard_map's out_specs to carry
+
     if need_probs:
-        (_, _, pool, _), (toks_out, probs_out) = jax.lax.scan(
-            step, carry0, jnp.arange(k + 1))
-        return toks_out[:k], probs_out[:k], pool
-    (_, _, pool, _), toks_out = jax.lax.scan(
-        step, carry0, jnp.arange(k + 1))
-    return toks_out[:k], None, pool
+        return _smap(body, mesh,
+                     (pspecs, rep, kvspecs, rep, rep, rep, rep, rep),
+                     (rep, rep, kvspecs))(
+            params, tokens, pool, positions, tables, n_prop, temps, key)
+    toks_out, pool = _smap(
+        body, mesh,
+        (pspecs, rep, kvspecs, rep, rep, rep, rep, rep),
+        (rep, kvspecs))(
+        params, tokens, pool, positions, tables, n_prop, temps, key)
+    return toks_out, None, pool
 
 
 __all__ = [
     "init_paged_kv", "copy_pages", "prefill_batch_paged",
     "prefill_chunk_paged", "verify_chunk_paged", "spec_draft_propose",
     "decode_step_paged", "decode_multi_paged",
+    "KV_POOL_PARTITION_RULES", "prefill_chunk_paged_tp",
+    "verify_chunk_paged_tp", "decode_step_paged_tp",
+    "decode_multi_paged_tp", "copy_pages_tp", "spec_draft_propose_tp",
 ]

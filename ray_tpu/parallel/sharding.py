@@ -1,78 +1,18 @@
-"""Logical-axis → PartitionSpec machinery.
+"""Logical-axis → PartitionSpec machinery (re-export).
 
-Models annotate every parameter with logical axis names (e.g. ("embed","mlp")).
-At jit time those are resolved against the active rule table and mesh into
-`NamedSharding`s. This is the TPU-native replacement for the reference's
-process-group + DDP wrapper approach (`/root/reference/python/ray/train/torch/
-config.py`): instead of wrapping a module, we annotate the pytree and let
-pjit/XLA partition the program.
+The implementation moved to ``ray_tpu.models.partition`` so the repo has
+ONE spec-derivation module: regex rule tables (serving tensor
+parallelism) and logical-axis resolution (train-side SPMD) live
+side-by-side there. This module survives as the stable import path for
+the train stack (`train/spmd.py`, `train/memory_audit.py`, tests).
 """
 
 from __future__ import annotations
 
-from typing import Any
+from ray_tpu.models.partition import (  # noqa: F401
+    logical_to_spec,
+    shard_tree,
+    tree_to_shardings,
+)
 
-import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
-
-from ray_tpu.parallel.mesh import DEFAULT_LOGICAL_RULES
-
-
-def logical_to_spec(
-    logical_axes: tuple[Any, ...],
-    rules: tuple[tuple[str, Any], ...] = DEFAULT_LOGICAL_RULES,
-    *,
-    mesh: Mesh | None = None,
-) -> PartitionSpec:
-    """Map a tuple of logical axis names to a PartitionSpec.
-
-    If `mesh` is given, any mesh axis of size 1 (or absent) resolves to None so
-    the same rules work on a single chip and a pod. A mesh axis may be consumed
-    by at most one dimension of a given array.
-    """
-    table = dict(rules)
-    used: set[str] = set()
-    out: list[Any] = []
-    for ax in logical_axes:
-        mapped = table.get(ax) if ax is not None else None
-        if mapped is None:
-            out.append(None)
-            continue
-        axes = (mapped,) if isinstance(mapped, str) else tuple(mapped)
-        kept = []
-        for m in axes:
-            if m in used:
-                continue
-            if mesh is not None and mesh.shape.get(m, 1) == 1:
-                continue
-            kept.append(m)
-            used.add(m)
-        if not kept:
-            out.append(None)
-        elif len(kept) == 1:
-            out.append(kept[0])
-        else:
-            out.append(tuple(kept))
-    while out and out[-1] is None:
-        out.pop()
-    return PartitionSpec(*out)
-
-
-def tree_to_shardings(
-    logical_tree: Any,
-    mesh: Mesh,
-    rules: tuple[tuple[str, Any], ...] = DEFAULT_LOGICAL_RULES,
-) -> Any:
-    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
-    return jax.tree.map(
-        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules, mesh=mesh)),
-        logical_tree,
-        is_leaf=lambda x: isinstance(x, tuple) and all(
-            a is None or isinstance(a, str) for a in x
-        ),
-    )
-
-
-def shard_tree(tree: Any, shardings: Any) -> Any:
-    """Device-put a pytree according to a matching pytree of shardings."""
-    return jax.tree.map(lambda x, s: jax.device_put(x, s), tree, shardings)
+__all__ = ["logical_to_spec", "tree_to_shardings", "shard_tree"]

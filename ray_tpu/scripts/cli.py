@@ -211,7 +211,10 @@ def render_serve_status(history: bool = False,
             for key in ("queue_depth", "active_slots", "prefilling_slots",
                         "pool_pages_free", "pool_pages_total",
                         "prefill_budget_util", "ttft_ewma_ms",
-                        "decode_tok_s_ewma", "spec_accepted_per_step"):
+                        "decode_tok_s_ewma", "spec_accepted_per_step",
+                        # Sharding topology (tensor-parallel replicas
+                        # export these; single-chip engines omit them).
+                        "llm_tp", "pool_shard_bytes_used"):
                 if key in eng:
                     bits.append(f"{key}={eng[key]}")
             lines.append(f"    replica {r['replica']}: " + " ".join(bits))

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import functools
 import math
 import queue
 import threading
@@ -304,7 +305,7 @@ class LLMEngine:
                  prefix_cache: bool | None = None,
                  prefix_cache_pages: int | None = None,
                  spec_draft=None, spec_k: int | None = None,
-                 spec_draft_params=None):
+                 spec_draft_params=None, tp: int | None = None):
         import types
 
         import jax
@@ -362,10 +363,11 @@ class LLMEngine:
         chunk_explicit = prefill_chunk is not None
         cache_explicit = prefix_cache is not None
         spec_explicit = spec_draft is not None
+        tp_explicit = tp is not None
         if (kv_mode is None or page_size is None or attn_impl is None
                 or prefill_chunk is None or prefill_token_budget is None
                 or prefix_cache is None or prefix_cache_pages is None
-                or spec_draft is None or spec_k is None):
+                or spec_draft is None or spec_k is None or tp is None):
             from ray_tpu.core.config import runtime_config
 
             _rc = runtime_config()
@@ -387,6 +389,7 @@ class LLMEngine:
             spec_draft = (_rc.llm_spec_draft if spec_draft is None
                           else spec_draft)
             spec_k = _rc.llm_spec_k if spec_k is None else spec_k
+            tp = _rc.llm_tp if tp is None else tp
         if prefill_chunk and kv_mode != "paged" and not chunk_explicit:
             # The global llm_prefill_chunk knob applies to paged engines;
             # a dense engine alongside it just keeps one-shot admission
@@ -469,6 +472,56 @@ class LLMEngine:
                     f"vocab_size {draft_cfg.vocab_size} != target "
                     f"vocab_size {cfg.vocab_size} (the tokenizer must be "
                     "tied)")
+        # Tensor-parallel decode (models/partition.py): tp > 1 runs every
+        # paged program per-shard over a ("tp",) mesh with params and the
+        # KV pool sharded along the head axis. Same validation pattern as
+        # llm_prefill_chunk: the GLOBAL llm_tp knob alongside an
+        # incompatible engine soft-disables to 1; explicit constructor
+        # args raise typed errors. tp=1 is byte-for-byte the single-chip
+        # engine (no mesh, no shard_map — the untouched dispatch table).
+        tp = int(tp)
+        if tp < 1:
+            raise ValueError(f"llm_tp must be >= 1, got {tp}")
+        if tp > 1 and not (kv_mode == "paged" and prefill_chunk):
+            if tp_explicit:
+                raise ValueError(
+                    "tensor-parallel decode requires kv_mode='paged' AND "
+                    "prefill_chunk > 0 (the sharded programs are the "
+                    f"paged chunked set); got kv_mode={kv_mode!r}, "
+                    f"prefill_chunk={prefill_chunk}")
+            tp = 1
+        self.mesh = None
+        if tp > 1 and not tp_explicit and (
+                tp > len(jax.devices())
+                or cfg.n_heads % tp or cfg.d_ff % tp
+                or (draft_cfg is not None
+                    and (draft_cfg.n_heads % tp or draft_cfg.d_ff % tp))):
+            # GLOBAL knob misfit (too few devices / non-divisor): serve
+            # unsharded rather than refuse to boot — a fleet-wide
+            # RAY_TPU_LLM_TP export must not crash the replicas whose
+            # host or model it doesn't fit (the PR 10
+            # _cpu_worker_xla_flags lesson). Explicit args stay strict
+            # below; metrics/llm_tp expose the degrade.
+            tp = 1
+        if tp > 1:
+            # The mesh build IS the device-count validation (one
+            # spelling of that error, models/partition.make_tp_mesh).
+            from ray_tpu.models import partition as _partition
+
+            self.mesh = _partition.make_tp_mesh(tp)
+            if cfg.n_heads % tp or cfg.d_ff % tp:
+                raise ValueError(
+                    f"llm_tp={tp} must divide the model's n_heads "
+                    f"({cfg.n_heads}) and d_ff ({cfg.d_ff}) — the KV pool "
+                    "shards along the head axis and the MLP along its "
+                    "hidden width")
+            if draft_cfg is not None and (
+                    draft_cfg.n_heads % tp or draft_cfg.d_ff % tp):
+                raise ValueError(
+                    f"llm_tp={tp} must divide the DRAFT model's n_heads "
+                    f"({draft_cfg.n_heads}) and d_ff ({draft_cfg.d_ff}) "
+                    "— the draft pool shards along the same head axis")
+        self.tp = tp
         self.kv_mode = kv_mode
         # Paged-decode attention path (models/paged_kv.py): "kernel" = the
         # Pallas ragged paged-attention kernel, "gather" = the exact-match
@@ -542,6 +595,47 @@ class LLMEngine:
             # from a host-side generator: they gate host control flow
             # (emit / rollback), so deviceifying them buys nothing.
             self._spec_rng = np.random.default_rng(seed)
+        if self.tp > 1:
+            # Shard ONCE at load onto the mesh validation built: params
+            # (target + draft) per gpt.partition_rules, page pools along
+            # the head axis — then swap the paged dispatch table for the
+            # shard_map twins with the mesh bound as a static kwarg, so
+            # every call site (and every byte of host-side
+            # scheduler/allocator state: page ids, tables, cursors) is
+            # unchanged. Wrapped under the SAME compile-watch names as
+            # the single-shard programs: shard-induced recompiles
+            # attribute to the owning program at /metrics and in the
+            # storm alarm.
+            from ray_tpu.models import partition as _partition
+
+            self.params = _partition.shard_by_rules(
+                self.mesh, gpt.partition_rules(), self.params)
+            self.cache = _partition.shard_by_rules(
+                self.mesh, _paged.KV_POOL_PARTITION_RULES, self.cache)
+            if spec_draft:
+                self.draft_params = _partition.shard_by_rules(
+                    self.mesh, gpt.partition_rules(), self.draft_params)
+                self.draft_cache = _partition.shard_by_rules(
+                    self.mesh, _paged.KV_POOL_PARTITION_RULES,
+                    self.draft_cache)
+            _mp = functools.partial
+            self._rt.prefill_chunk_paged = _w(
+                _mp(_paged.prefill_chunk_paged_tp, mesh=self.mesh),
+                "prefill_chunk_paged")
+            self._rt.verify_chunk_paged = _w(
+                _mp(_paged.verify_chunk_paged_tp, mesh=self.mesh),
+                "verify_chunk_paged")
+            self._rt.decode_step_paged = _w(
+                _mp(_paged.decode_step_paged_tp, mesh=self.mesh),
+                "decode_step_paged")
+            self._rt.decode_multi_paged = _w(
+                _mp(_paged.decode_multi_paged_tp, mesh=self.mesh),
+                "decode_multi_paged")
+            self._rt.copy_pages = _w(
+                _mp(_paged.copy_pages_tp, mesh=self.mesh), "copy_pages")
+            self._rt.spec_draft_propose = _w(
+                _mp(_paged.spec_draft_propose_tp, mesh=self.mesh),
+                "spec_draft_propose")
         self._spec_accept_ewma: float | None = None
         self._spec_span_seq = 0
         # Prefix cache (serve/prefix_cache.py): refcounted COW page
@@ -962,6 +1056,14 @@ class LLMEngine:
                 m["kv_pages_free_min"] = self._min_free_pages
                 m["kv_page_size"] = self.page_size
                 m["llm_attn_impl"] = self.attn_impl
+            m["llm_tp"] = self.tp
+            if self.tp > 1:
+                m["mesh_shape"] = {"tp": self.tp}
+                m["kv_heads_per_shard"] = self.cfg.n_heads // self.tp
+                m["pool_shard_bytes"] = self._pool_shard_bytes()
+                m["pool_shard_bytes_used"] = round(
+                    self._pool_shard_bytes()
+                    * (1.0 - len(self.free_pages) / self.n_pages))
             if self.prefill_chunk:
                 m["prefill_chunk"] = self.prefill_chunk
                 m["prefill_token_budget"] = self.prefill_budget
@@ -1059,6 +1161,19 @@ class LLMEngine:
                 snap["pool_pages_free_min"] = self._min_free_pages
                 snap["pool_utilization"] = round(
                     1.0 - len(self.free_pages) / self.n_pages, 4)
+            if self.tp > 1:
+                # Sharding topology, riding the PR 6 chain as-is:
+                # Replica.stats() → controller probe → serve.status() /
+                # /api/serve/load / `ray_tpu status --serve`. Page ids
+                # (and thus occupancy FRACTION) are shard-invariant; the
+                # per-shard number is the bytes each device pins.
+                snap["llm_tp"] = self.tp
+                snap["mesh_shape"] = {"tp": self.tp}
+                snap["kv_heads_per_shard"] = self.cfg.n_heads // self.tp
+                snap["pool_shard_bytes"] = self._pool_shard_bytes()
+                snap["pool_shard_bytes_used"] = round(
+                    self._pool_shard_bytes()
+                    * (1.0 - len(self.free_pages) / self.n_pages))
             if self.prefill_chunk:
                 snap["prefill_chunk"] = self.prefill_chunk
                 snap["prefill_token_budget"] = self.prefill_budget
@@ -1105,6 +1220,15 @@ class LLMEngine:
     def _pages_for(self, last_pos: int) -> int:
         """Pages needed to cover writes up to position `last_pos`."""
         return last_pos // self.page_size + 1
+
+    def _pool_shard_bytes(self) -> int:
+        """Per-device bytes of the KV pool (K + V planes, null page
+        included). Page ids are shard-invariant — every shard holds
+        every page — so at tp > 1 each shard's cut is the head slice:
+        total pool bytes / tp. The topology number `serve.status()` /
+        `/api/serve/load` / the CLI render."""
+        k = self.cache["k"]
+        return int(2 * math.prod(k.shape) * k.dtype.itemsize) // self.tp
 
     def _alloc_page(self) -> int | None:
         """One exclusive page off the free list (refcount 1), or None
