@@ -274,8 +274,41 @@ def main() -> None:
                     help="distinct prompts the real-replica clients"
                          " rotate through (exactness baselines are"
                          " precomputed per pool member)")
+    ap.add_argument("--pool-split", default="",
+                    help="'P:D' — real-replica mode deploys a "
+                         "DISAGGREGATED stack: P prefill-pool replicas "
+                         "(own the /bench route, donate KV page sets "
+                         "at the first token) + D decode-pool replicas "
+                         "(adopt the pages by reference). Requires "
+                         "--real-replicas (any value; the split counts "
+                         "win), paged KV and chunked prefill. With "
+                         "--chaos-kill-at the SIGKILL lands on a "
+                         "PREFILL replica inside a donation (the "
+                         "donor-death scenario) instead of a decode "
+                         "window.")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
+    pool_split = None
+    if args.pool_split:
+        try:
+            p, d = (int(x) for x in args.pool_split.split(":"))
+        except ValueError:
+            ap.error("--pool-split must be 'P:D' replica counts")
+        if p < 1 or d < 1:
+            ap.error("--pool-split needs P >= 1 and D >= 1")
+        if not args.real_replicas:
+            ap.error("--pool-split requires --real-replicas (the pools "
+                     "are serve deployments)")
+        if args.kv_mode != "paged" or not args.prefill_chunk:
+            ap.error("--pool-split requires --kv-mode paged and "
+                     "--prefill-chunk > 0 (page sets are keyed at the "
+                     "prefill-chunk granularity)")
+        if args.autoscale_mode != "off":
+            ap.error("--pool-split deploys FIXED pool sizes (stable "
+                     "denominators for the r13 comparison) — it cannot "
+                     "combine with --autoscale-mode other than 'off'")
+        pool_split = (p, d)
+    args.pool_split_parsed = pool_split
     if not 0.0 <= args.shared_prefix_frac <= 1.0:
         ap.error("--shared-prefix-frac must be in [0, 1]")
     if args.turns < 1:
@@ -744,38 +777,75 @@ def _run_real(args, phases) -> None:
     }
     if args.spill_ongoing is not None:
         sys_cfg["serve_router_spill_ongoing"] = args.spill_ongoing
-    ray_tpu.init(num_cpus=args.max_replicas + 3, _system_config=sys_cfg)
+    split = getattr(args, "pool_split_parsed", None)
+    n_cpus = (sum(split) if split else args.max_replicas) + 3
+    ray_tpu.init(num_cpus=n_cpus, _system_config=sys_cfg)
     t_start = time.perf_counter()
     events: list = []
     try:
         target = (args.target_ongoing if args.target_ongoing
                   else float(args.n_slots))
-        dep = serve.deployment(LLMDeployment, name="bench").options(
-            num_replicas=args.real_replicas, route_prefix="/bench",
-            # mode=off pins the replica count (router/cache ablations
-            # need a FIXED denominator — any autoscaling_config would
-            # also arm the legacy reactive policy).
-            autoscaling_config=None if args.autoscale_mode == "off" else {
-                "min_replicas": 1, "max_replicas": args.max_replicas,
-                "target_ongoing_requests": target,
-            }).bind(args.model, n_slots=args.n_slots,
-                    max_len=args.max_len, jax_platform="cpu",
-                    engine_kwargs=engine_kwargs)
-        handle = serve.run(dep, timeout=600.0)
+        if split:
+            # Disaggregated stack: the /bench route belongs to the
+            # PREFILL pool; its replicas donate KV page sets at the
+            # first token and hand off to the decode pool, whose
+            # replicas adopt the pages by reference. Fixed counts —
+            # the r13 comparison needs stable denominators.
+            n_pre, n_dec = split
+            decode_dep = serve.deployment(
+                LLMDeployment, name="bench-decode",
+                pool_role="decode").options(
+                num_replicas=n_dec, route_prefix=None).bind(
+                args.model, n_slots=args.n_slots, max_len=args.max_len,
+                jax_platform="cpu", pool_role="decode",
+                engine_kwargs=dict(engine_kwargs))
+            prefill_dep = serve.deployment(
+                LLMDeployment, name="bench",
+                pool_role="prefill").options(
+                num_replicas=n_pre, route_prefix="/bench").bind(
+                args.model, n_slots=args.n_slots, max_len=args.max_len,
+                jax_platform="cpu", pool_role="prefill",
+                pool_peer="bench-decode",
+                engine_kwargs=dict(engine_kwargs))
+            serve.run(decode_dep, timeout=600.0)
+            handle = serve.run(prefill_dep, timeout=600.0)
+        else:
+            dep = serve.deployment(LLMDeployment, name="bench").options(
+                num_replicas=args.real_replicas, route_prefix="/bench",
+                # mode=off pins the replica count (router/cache
+                # ablations need a FIXED denominator — any
+                # autoscaling_config would also arm the legacy
+                # reactive policy).
+                autoscaling_config=(
+                    None if args.autoscale_mode == "off" else {
+                        "min_replicas": 1,
+                        "max_replicas": args.max_replicas,
+                        "target_ongoing_requests": target,
+                    })).bind(args.model, n_slots=args.n_slots,
+                             max_len=args.max_len, jax_platform="cpu",
+                             engine_kwargs=engine_kwargs)
+            handle = serve.run(dep, timeout=600.0)
         _proxy, port = serve.start_proxy()
         # Warm EVERY initial replica's compile cache at the REAL output
         # length (a width the warmup never visited would compile
         # mid-measurement): dispatch directly per routable replica —
         # routing the warmups through the load-balanced handle can
-        # leave a replica cold by chance.
+        # leave a replica cold by chance. In the split stack the decode
+        # replicas warm with a FULL generation (their engines compile
+        # prefill + adoption + decode programs) and the prefill
+        # replicas stop at their handoff envelope (first-token
+        # programs only — all they ever run).
         ctrl = _get_controller()
         table = ray_tpu.get(ctrl.get_routing.remote(-1), timeout=60)
-        for replica in table["routes"]["bench"]["replicas"]:
-            ray_tpu.get(replica.handle_request.remote(
-                "generate", (pool[0],),
-                {"max_tokens": args.max_tokens}), timeout=600)
+        warm_names = ["bench-decode", "bench"] if split else ["bench"]
+        for wname in warm_names:
+            for replica in table["routes"][wname]["replicas"]:
+                ray_tpu.get(replica.handle_request.remote(
+                    "generate", (pool[0],),
+                    {"max_tokens": args.max_tokens}), timeout=600)
         bench_chaos._sse_stream(port, "/bench", {
-            "prompt_ids": pool[0], "max_tokens": 2}, timeout_s=120)
+            "prompt_ids": pool[0], "max_tokens": args.max_tokens},
+            timeout_s=300)
 
         def counter_total(name: str) -> float:
             try:
@@ -788,7 +858,8 @@ def _run_real(args, phases) -> None:
         time.sleep(1.0)     # let warmup metrics flush before baselining
         c0 = {name: counter_total(name) for name in (
             "serve_requests_shed_total", "serve_failovers_total",
-            "serve_drain_total")}
+            "serve_drain_total", "serve_handoffs_total",
+            "llm_kv_adoptions_total", "llm_kv_adopt_failures_total")}
 
         stop = threading.Event()
         traj: list = []
@@ -822,13 +893,20 @@ def _run_real(args, phases) -> None:
                     table = ray_tpu.get(ctrl.get_routing.remote(-1),
                                         timeout=30)
                     reps = table["routes"]["bench"]["replicas"]
+                    # Split stack: the SIGKILL lands on a PREFILL
+                    # replica INSIDE a donation (serve.kv.donate) —
+                    # the donor-death scenario the adoption ladder
+                    # must absorb. Fused: the classic decode-window
+                    # kill.
+                    site = ("serve.kv.donate" if split
+                            else "llm.decode_window")
                     if reps:
                         ray_tpu.get(reps[-1].install_chaos.remote(
-                            [{"site": "llm.decode_window",
+                            [{"site": site,
                               "action": "kill", "after": 2}]), timeout=30)
                         events.append({
                             "t": round(time.perf_counter() - t_start, 2),
-                            "event": "chaos_sigkill_armed"})
+                            "event": f"chaos_sigkill_armed:{site}"})
                 except Exception as e:  # noqa: BLE001
                     events.append({"event": f"chaos arm failed: {e!r}"})
 
@@ -840,7 +918,8 @@ def _run_real(args, phases) -> None:
         for pi, (clients, dur) in enumerate(phases):
             deadline = time.perf_counter() + dur
             rec = {"completed": 0, "dropped": 0, "mismatched": 0,
-                   "shed": 0, "ttfts": [], "tok_s": [], "errs": []}
+                   "shed": 0, "ttfts": [], "tok_s": [], "gaps": [],
+                   "errs": []}
             plock = threading.Lock()
 
             def client(tid: int, deadline=deadline, rec=rec, plock=plock):
@@ -869,6 +948,12 @@ def _run_real(args, phases) -> None:
                             if len(a) > 1 and a[-1] > a[0]:
                                 rec["tok_s"].append(
                                     (len(a) - 1) / (a[-1] - a[0]))
+                            if len(a) > 1:
+                                # Worst inter-token stall per stream:
+                                # a handoff or failover shows up HERE —
+                                # the adopt-vs-re-prefill gap headline.
+                                rec["gaps"].append(max(
+                                    b - c for b, c in zip(a[1:], a)))
                     if r["error"] and "overloaded" in str(r["error"]):
                         time.sleep(0.5)     # honor the shed backoff
 
@@ -882,6 +967,7 @@ def _run_real(args, phases) -> None:
             wall = time.perf_counter() - t0
             ttfts = sorted(rec["ttfts"])
             toks = sorted(rec["tok_s"])
+            gaps = sorted(rec["gaps"])
             tail = traj[-1] if traj else {}
             row = {
                 "phase": pi, "clients": clients, "duration_s": dur,
@@ -908,6 +994,11 @@ def _run_real(args, phases) -> None:
                     toks[len(toks) // 2], 2)
                 row["stream_tok_s_p05"] = round(
                     toks[int(len(toks) * 0.05)], 2)
+            if gaps:
+                row["gap_p50_ms"] = round(
+                    gaps[len(gaps) // 2] * 1000, 1)
+                row["gap_p95_ms"] = round(
+                    gaps[int(len(gaps) * 0.95)] * 1000, 1)
             for k in totals:
                 totals[k] += rec[k]
             phase_rows.append(row)
@@ -919,14 +1010,24 @@ def _run_real(args, phases) -> None:
         # window must not be missed by an instant read.
         time.sleep(1.0)
         c1 = {name: counter_total(name) for name in c0}
-        # Final per-replica cache view (affinity evidence).
+        # Final per-replica cache view (affinity evidence) + the decode
+        # pool's adoption ledger (split stacks).
         hit_rates: list = []
         per_hits: list = []
         per_misses: list = []
         agg_hits = agg_misses = 0
+        kv_adoptions = kv_partial = kv_failures = kv_donations = 0
         try:
             ctrl = _get_controller()
             load = ray_tpu.get(ctrl.get_load.remote(), timeout=30)
+            for dep_name in (("bench", "bench-decode") if split
+                             else ("bench",)):
+                for r in load.get(dep_name, {}).get("replicas", []):
+                    eng = r.get("load") or {}
+                    kv_adoptions += int(eng.get("kv_adoptions", 0))
+                    kv_partial += int(eng.get("kv_partial_adoptions", 0))
+                    kv_failures += int(eng.get("kv_adopt_failures", 0))
+                    kv_donations += int(eng.get("kv_donations", 0))
             for r in load.get("bench", {}).get("replicas", []):
                 eng = r.get("load") or {}
                 if "prefix_cache_hit_rate" in eng:
@@ -937,6 +1038,39 @@ def _run_real(args, phases) -> None:
                 agg_misses += int(eng.get("prefix_cache_misses", 0))
         except Exception as e:  # noqa: BLE001
             events.append({"event": f"final load read failed: {e!r}"})
+
+        # End-of-run engine view per replica (quiescent): decode-step
+        # latency + burst-tick interference — the structural number the
+        # split buys (decode-pool engines never co-schedule a full
+        # prompt's prefill against live decodes; only 1-chunk cold
+        # suffixes after an adoption) — and the page-accounting closure
+        # the chaos acceptance demands.
+        engine_metrics: dict = {}
+        accounting_closed = True
+        try:
+            table = ray_tpu.get(ctrl.get_routing.remote(-1), timeout=30)
+            for dep_name in (("bench", "bench-decode") if split
+                             else ("bench",)):
+                rows = []
+                for replica in table["routes"][dep_name]["replicas"]:
+                    m = ray_tpu.get(replica.handle_request.remote(
+                        "metrics", (), {}), timeout=60)
+                    rows.append({k: m[k] for k in (
+                        "decode_step_ms_p50", "decode_step_ms_p95",
+                        "decode_step_burst_ms_p50",
+                        "decode_step_burst_ms_p95",
+                        "engine_decode_tok_s", "prefill_tokens",
+                        "kv_adoptions", "kv_donations", "preemptions")
+                        if k in m})
+                    acc = ray_tpu.get(replica.handle_request.remote(
+                        "page_accounting", (), {}), timeout=60)
+                    rows[-1]["page_accounting_closed"] = bool(
+                        acc["closure"] and acc["refs_consistent"])
+                    accounting_closed &= rows[-1][
+                        "page_accounting_closed"]
+                engine_metrics[dep_name] = rows
+        except Exception as e:  # noqa: BLE001
+            events.append({"event": f"engine metrics read failed: {e!r}"})
 
         recs = [s["recommended"] for s in traj
                 if s["recommended"] is not None]
@@ -958,8 +1092,19 @@ def _run_real(args, phases) -> None:
             "slo_ttft_ms": args.slo_ttft_ms,
             "chaos_kill_at_s": args.chaos_kill_at,
             "overload_queue_depth": args.overload_queue_depth,
+            "pool_split": (f"{split[0]}:{split[1]}" if split else None),
             "phases": phase_rows,
             **totals,
+            "kv_adoptions": kv_adoptions,
+            "kv_partial_adoptions": kv_partial,
+            "kv_adopt_failures": kv_failures,
+            "kv_donations": kv_donations,
+            "handoffs_delta": round(
+                c1["serve_handoffs_total"]
+                - c0["serve_handoffs_total"], 1),
+            "kv_adoptions_counter_delta": round(
+                c1["llm_kv_adoptions_total"]
+                - c0["llm_kv_adoptions_total"], 1),
             "shed_counter_delta": round(
                 c1["serve_requests_shed_total"]
                 - c0["serve_requests_shed_total"], 1),
@@ -986,6 +1131,8 @@ def _run_real(args, phases) -> None:
                 "tracked_down": bool(recs and lives
                                      and lives[-1] == recs[-1]),
             },
+            "engine_metrics": engine_metrics,
+            "page_accounting_closed": accounting_closed,
             "trajectory": traj,
             "events": events,
             "wall_s": round(time.perf_counter() - t_start, 2),

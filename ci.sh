@@ -45,6 +45,7 @@ case "$TIER" in
       tests/test_chunked_prefill.py   # chunked prefill + token budget
       tests/test_prefix_cache.py      # prefix cache: COW page sharing
       tests/test_spec_decode.py       # speculative decode: verify/rollback
+      tests/test_kv_objects.py        # KV page-set donate/adopt ladder
       tests/test_tp_decode.py         # tensor-parallel decode: tp=2 smoke
                                       # (self-skips if <2 XLA host devices)
       tests/test_tune.py              # Tune: schedulers/searchers
@@ -71,7 +72,8 @@ esac
 # fallback instead of importorskip'ing).
 for guarded in tests/test_tracing.py tests/test_paged_attention.py \
                tests/test_chunked_prefill.py tests/test_prefix_cache.py \
-               tests/test_spec_decode.py tests/test_tp_decode.py \
+               tests/test_spec_decode.py tests/test_kv_objects.py \
+               tests/test_tp_decode.py \
                tests/test_graftlint.py \
                tests/test_graftlint_v2.py tests/test_flight_recorder.py \
                tests/test_autoscale.py tests/test_router.py \

@@ -84,6 +84,14 @@ class ObjectRef:
             c.incref(object_id.binary())
 
     @classmethod
+    def from_hex(cls, hex_id: str) -> "ObjectRef":
+        """Borrowed-ref construction from a serialized object id (the KV
+        page-set index stores ids as hex in the GCS KV): counts as an
+        ordinary local reference — incref on build, release on GC — so
+        resolving an index entry pins the object for the read."""
+        return cls(ObjectID(bytes.fromhex(hex_id)))
+
+    @classmethod
     def _uncounted(cls, object_id: ObjectID) -> "ObjectRef":
         """A ref that holds NO local count (internal): used where another
         mechanism (e.g. refs-in-refs containment escrow) owns the lifetime
@@ -512,8 +520,8 @@ def remote(*args, **options):
 
 # --------------------------------------------------------------- data plane
 
-def put(value: Any) -> ObjectRef:
-    return _ensure_client().put(value)
+def put(value: Any, *, _cache_local: bool = True) -> ObjectRef:
+    return _ensure_client().put(value, cache_local=_cache_local)
 
 
 def get(refs, timeout: float | None = None):

@@ -53,6 +53,17 @@ Wired sites (kept in SITES so tests can assert coverage):
                                  (drop → handles/proxies must keep
                                  serving from their cached table and
                                  converge via the TTL refresh)
+    serve.kv.donate              KV page-set donation to the object
+                                 store (raise → donation skipped, the
+                                 engine keeps serving and page
+                                 accounting must still close; kill →
+                                 donor process dies mid-donation, the
+                                 SIGKILL-mid-adoption scenario)
+    serve.kv.adopt               KV page-set fetch during admission
+                                 adoption (drop → the transfer fails
+                                 and the adoption ladder must fall to
+                                 partial-adopt / re-prefill with zero
+                                 dropped tokens; delay → slow transfer)
 """
 
 from __future__ import annotations
@@ -76,6 +87,8 @@ SITES = (
     "serve.controller.ckpt_write",
     "serve.controller.enact",
     "serve.routes.push",
+    "serve.kv.donate",
+    "serve.kv.adopt",
 )
 
 _ACTIONS = ("kill", "raise", "drop", "delay")

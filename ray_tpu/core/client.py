@@ -416,7 +416,7 @@ class CoreClient:
                 pass
         return True
 
-    def put(self, value: Any):
+    def put(self, value: Any, *, cache_local: bool = True):
         from ray_tpu.api import ObjectRef
 
         obj = ObjectID.from_put(self.task_id_root, next(self._put_counter))
@@ -428,7 +428,13 @@ class CoreClient:
             # keeps its inner refs alive until it is itself freed.
             self.refcounter.add_contains(obj.binary(), nested)
         self._run(self._store_serialized(obj.binary(), head, views))
-        self._memory_store[obj.binary()] = value
+        if cache_local:
+            self._memory_store[obj.binary()] = value
+        # cache_local=False: the node store's extent is the ONLY copy —
+        # for bulk donations (KV page sets) the default would pin a full
+        # second copy of every donated page in the owner's process RAM
+        # for the object's whole lifetime. Reads (owner included) go
+        # through the ordinary store path.
         return ObjectRef(obj)
 
     async def _read_remote_chunks(self, oid: bytes,

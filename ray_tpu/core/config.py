@@ -367,6 +367,30 @@ class Config:
     # XLA_FLAGS=--xla_force_host_platform_device_count=N forks virtual
     # host devices (TESTING.md). Env: RAY_TPU_LLM_TP=2.
     llm_tp: int = 1
+    # KV page-set transfer (serve/kv_objects.py): completed prefills and
+    # drain exports donate their written KV pages as refcounted,
+    # chunk-chain-keyed page-set objects; an admitting engine ADOPTS
+    # resolvable page sets by reference instead of re-prefilling
+    # (failover ladder: adopt → partial-adopt + cold-suffix prefill →
+    # teacher-forced re-prefill). Requires kv_mode="paged" AND
+    # llm_prefill_chunk > 0 AND llm_tp == 1; on any misfit the GLOBAL
+    # knob soft-disables (a fleet-wide export must not crash replica
+    # boot) while explicit constructor args raise typed errors, like
+    # llm_prefill_chunk. Forced on by pool_role (disaggregated
+    # prefill/decode pools — the handoff IS a donation + adoption).
+    llm_kv_transfer: bool = False
+    # Max page-set entries one donor engine keeps alive (oldest
+    # donations are withdrawn first — their objects freed and index
+    # entries dropped — so a long-lived donor can't pin the object
+    # store full of stale KV).
+    serve_kv_object_budget: int = 64
+    # Donated page-set lifetime: the controller's orphan sweep frees
+    # entries older than this, and entries whose donor replica is no
+    # longer a member of any deployment (dead donors can't leak pages).
+    serve_kv_object_ttl_s: float = 120.0
+    # Cadence of the controller-side orphan sweep (full reconcile
+    # passes only).
+    serve_kv_sweep_interval_s: float = 10.0
 
     # --- flight recorder (compile watch + SLO monitor) ---
     # Recompile-storm alarm (ray_tpu/compile_watch.py): a structured

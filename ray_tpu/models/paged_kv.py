@@ -75,6 +75,31 @@ def copy_pages(pool, src, dst):
     return {k: v.at[:, dst].set(v[:, src]) for k, v in pool.items()}
 
 
+@jax.jit
+def gather_pages(pool, pages):
+    """Read pages ``pages[i]`` out of the pool across every layer for
+    both K and V in ONE fused dispatch → ``{"k": [L, n, ps, H, Kd],
+    "v": ...}``. The donation path of the KV page-set store
+    (serve/kv_objects.py): the caller pads ``pages`` to a power-of-two
+    length with null-page (0) ids — reading the null page is harmless
+    by layout convention — so the gather lowers one program per width
+    bucket, not one per page count."""
+    return {k: v[:, pages] for k, v in pool.items()}
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def scatter_pages(pool, pages, k_data, v_data):
+    """Write page payloads ``(k_data, v_data)[:, i]`` into pool rows
+    ``pages[i]`` across every layer in ONE fused dispatch — the
+    adoption path of the KV page-set store. Padding convention mirrors
+    copy_pages: the caller pads ``pages`` with null-page (0) ids and
+    zero payloads; writes to the null page are harmless, and real
+    target ids are freshly allocated (never aliased), so scatter order
+    cannot matter."""
+    return {"k": pool["k"].at[:, pages].set(k_data),
+            "v": pool["v"].at[:, pages].set(v_data)}
+
+
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
 def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
     """Prefill N prompts, scattering their K/V into allocated pages.
@@ -720,7 +745,8 @@ def spec_draft_propose_tp(cfg: GPTConfig, params, tokens, pool, positions,
 
 
 __all__ = [
-    "init_paged_kv", "copy_pages", "prefill_batch_paged",
+    "init_paged_kv", "copy_pages", "gather_pages", "scatter_pages",
+    "prefill_batch_paged",
     "prefill_chunk_paged", "verify_chunk_paged", "spec_draft_propose",
     "decode_step_paged", "decode_multi_paged",
     "KV_POOL_PARTITION_RULES", "prefill_chunk_paged_tp",

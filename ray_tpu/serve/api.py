@@ -39,12 +39,19 @@ _DEAD_CAP = 4096
 
 def _dead_actors():
     """The process's dead-replica id set (bytes actor ids), arming the
-    actor-death subscription on first use / client re-init."""
+    actor-death subscription on first use / client re-init. Gated on an
+    ALREADY attached client: reading the dead set off-cluster must not
+    BOOT a cluster as a side effect (`_ensure_client` auto-inits — the
+    PR 12 handle-constructor lesson, now enforced at every entry
+    point); without a client the current (possibly empty) set serves,
+    and arming happens on the first call after init()."""
     import collections
 
     from ray_tpu import api as _api
 
-    client = _api._ensure_client()
+    client = _api._client
+    if client is None:
+        return _dead_state["dead"]
     if _dead_state["client"] is not client:
         _dead_state["client"] = client
         _dead_state["dead"] = collections.OrderedDict()
@@ -94,7 +101,12 @@ def _pushed_version() -> int:
     from ray_tpu import api as _api
     from ray_tpu.serve.controller import ROUTES_CHANNEL
 
-    client = _api._ensure_client()
+    # Gate on an already attached client (never _ensure_client): this
+    # runs on every staleness check — including from handles built
+    # off-cluster in unit tests — and must not auto-boot a cluster.
+    client = _api._client
+    if client is None:
+        return _push_state["version"]
     if _push_state["client"] is not client:
         _push_state["client"] = client
         _push_state["version"] = -1
@@ -169,6 +181,15 @@ class Deployment:
     #  "upscale_delay_s", "downscale_delay_s"} — queue-depth autoscaling
     # (ref: _private/autoscaling_policy.py). None = fixed num_replicas.
     autoscaling_config: dict | None = None
+    # Disaggregated serving pools (serve_pool_role): "prefill" /
+    # "decode" marks this deployment's replica pool; None = fused
+    # (every replica does both — today's behavior). The role rides the
+    # controller routing table for observability and router awareness;
+    # the handoff mechanics live in LLMDeployment(pool_role=,
+    # pool_peer=) — prefill replicas donate KV pages and migrate the
+    # stream, decode replicas adopt. Each pool autoscales
+    # independently through its own deployment record.
+    pool_role: str | None = None
 
     def options(self, **kw) -> "Deployment":
         import dataclasses
@@ -189,7 +210,8 @@ def deployment(_func_or_class=None, *, name: str | None = None,
                ray_actor_options: dict | None = None,
                max_concurrent_queries: int = 8,
                user_config: Any = None,
-               autoscaling_config: dict | None = None):
+               autoscaling_config: dict | None = None,
+               pool_role: str | None = None):
     def make(target):
         return Deployment(
             func_or_class=target,
@@ -203,6 +225,7 @@ def deployment(_func_or_class=None, *, name: str | None = None,
             max_concurrent_queries=max_concurrent_queries,
             user_config=user_config,
             autoscaling_config=autoscaling_config,
+            pool_role=pool_role,
         )
 
     if _func_or_class is not None:
@@ -514,7 +537,10 @@ class DeploymentHandle:
                     self._local_inflight[aid] = n - 1
 
         try:
-            _api._ensure_client().get_future(ref).add_done_callback(_done)
+            client = _api._client
+            if client is None:
+                raise RuntimeError("client torn down mid-dispatch")
+            client.get_future(ref).add_done_callback(_done)
         except Exception:  # graftlint: disable=EXC-SWALLOW
             # Client torn down mid-dispatch: settle the inflight counter
             # immediately so the p2c signal can't leak a phantom request.
@@ -566,13 +592,23 @@ class DeploymentHandle:
         def gen():
             import time as _time
 
-            from ray_tpu.serve.http_proxy import _FAILOVERS, failover_mode
+            from ray_tpu.serve.http_proxy import (_FAILOVERS, _HANDOFFS,
+                                                  absorb_handoff,
+                                                  failover_mode)
 
             emitted: list = []
             budget = attempts
+            hops = 0
             t_end = _time.monotonic() + deadline_s
             replica = None
             sid = None
+            cur = self        # current handle: a pool handoff switches it
+            handles = {self.deployment_name: self}
+            # Resume context from a donor's handoff/export: the KV
+            # page-set descriptor + memoized hash chain ride every
+            # resubmit, so the destination engine walks the adoption
+            # ladder instead of unconditionally re-prefilling.
+            carry: dict = {}
             # Prefix affinity holds for the FIRST placement only: a
             # resume after death/drain re-picks purely by load (the
             # preferred replica just proved unreliable, and the PR 9
@@ -582,7 +618,7 @@ class DeploymentHandle:
             def _call(replica, method, *call_args):
                 # Tracked like method() dispatches: long token streams
                 # must weigh on the local p2c signal.
-                return self.dispatch(replica, method, call_args, {})
+                return cur.dispatch(replica, method, call_args, {})
 
             def _resume(mode: str, victim, dead: bool = False) -> bool:
                 # Mirrors HTTPProxy._stream_sse._failover — the protocol
@@ -594,7 +630,7 @@ class DeploymentHandle:
                     return False
                 budget -= 1
                 if victim is not None:
-                    self.evict_replica(victim, dead=dead)
+                    cur.evict_replica(victim, dead=dead)
                 _FAILOVERS.inc(1.0, tags={
                     "route": self.deployment_name,
                     "mode": f"stream_{mode}"})
@@ -602,11 +638,18 @@ class DeploymentHandle:
                 key = None          # failover re-picks by load
                 return True
 
+            def _absorb_handoff(out) -> str | None:
+                # → destination deployment name for a pool handoff,
+                # else None; updates the resume context either way
+                # (absorb_handoff is THE one copy of the transfer).
+                return absorb_handoff(out.get("handoff"), carry)
+
             while True:
                 try:
                     if sid is None:
-                        replica = self._pick_replica(key)
+                        replica = cur._pick_replica(key)
                         req = dict(request)
+                        req.update(carry)
                         if emitted:
                             req["generated_ids"] = list(emitted)
                         sid = ray_tpu.get(
@@ -636,6 +679,29 @@ class DeploymentHandle:
                     raise RuntimeError(err)
                 if out.get("done"):
                     if out.get("migrated"):
+                        peer = _absorb_handoff(out)
+                        if peer is not None:
+                            if hops >= 4:
+                                # Pool ring: the typed loop error (like
+                                # the unary paths) — never drain
+                                # failover chasing the ring.
+                                raise RuntimeError(
+                                    "pool handoff loop: stream still "
+                                    f"migrating after {hops} hops "
+                                    "(check pool_role/pool_peer "
+                                    "wiring)")
+                            # Pool handoff (prefill → decode): the
+                            # NORMAL path of a split deployment, not a
+                            # failure — no failover budget spent.
+                            hops += 1
+                            if peer not in handles:
+                                handles[peer] = DeploymentHandle(peer)
+                            cur = handles[peer]
+                            sid = None
+                            key = None
+                            _HANDOFFS.inc(1.0, tags={
+                                "route": self.deployment_name})
+                            continue
                         if _resume("drain", replica):
                             continue
                         raise RuntimeError(
@@ -714,11 +780,15 @@ def run(target: Deployment, *, name: str | None = None,
             resources["CPU"] = dep.ray_actor_options["num_cpus"]
         if "num_tpus" in dep.ray_actor_options:
             resources["TPU"] = dep.ray_actor_options["num_tpus"]
+    if dep.pool_role not in (None, "prefill", "decode"):
+        raise ValueError(
+            f"pool_role must be None|'prefill'|'decode', got "
+            f"{dep.pool_role!r}")
     ray_tpu.get(ctrl.deploy.remote(
         dep.name, cls_blob, dep.init_args, dep.init_kwargs,
         dep.num_replicas, dep.route_prefix, resources,
         dep.max_concurrent_queries, dep.user_config,
-        dep.autoscaling_config,
+        dep.autoscaling_config, dep.pool_role,
     ), timeout=remaining())
     handle = DeploymentHandle(dep.name)
     if _blocking_until_ready:

@@ -74,7 +74,7 @@ _REPLICA_LOAD_GAUGES = {
 _CKPT_FIELDS = (
     "name", "cls_blob", "init_args", "init_kwargs", "num_replicas",
     "route_prefix", "resources", "max_concurrent_queries", "user_config",
-    "autoscaling", "autoscaling_spec", "generation",
+    "autoscaling", "autoscaling_spec", "generation", "pool_role",
 )
 
 
@@ -122,6 +122,7 @@ class ServeController:
                             policy=AutoscalePolicy.from_config(self._cfg),
                             mode=mode))
         self._autoscale_last = 0.0
+        self._kv_sweep_last = 0.0
         # (deployment, replica short id) pairs with live history gauges —
         # diffed each full reconcile so removed replicas' series retire.
         self._load_series: set[tuple[str, str]] = set()
@@ -152,7 +153,10 @@ class ServeController:
                            "empty): %s", e)
             return
         for name, rec in snap.get("deployments", {}).items():
-            d = {k: rec[k] for k in _CKPT_FIELDS}
+            # .get: fields added after a checkpoint was written (e.g.
+            # pool_role) restore as None instead of refusing the whole
+            # snapshot.
+            d = {k: rec.get(k) for k in _CKPT_FIELDS}
             d["over_since"] = None
             d["under_since"] = None
             d["cold_ts"] = None
@@ -244,7 +248,8 @@ class ServeController:
                resources: dict | None,
                max_concurrent_queries: int = 8,
                user_config: Any = None,
-               autoscaling_config: dict | None = None) -> bool:
+               autoscaling_config: dict | None = None,
+               pool_role: str | None = None) -> bool:
         if autoscaling_config:
             ac = dict(autoscaling_config)
             ac.setdefault("min_replicas", 1)
@@ -274,6 +279,7 @@ class ServeController:
                 and old["user_config"] == user_config
                 and old.get("autoscaling_spec") == autoscaling_config
                 and (ac is None) == (old.get("autoscaling") is None)
+                and old.get("pool_role") == pool_role
             )
             if same_cfg and (ac is not None
                              or old["num_replicas"] == num_replicas):
@@ -305,6 +311,10 @@ class ServeController:
                     "user_config": user_config,
                     "autoscaling": ac,
                     "autoscaling_spec": autoscaling_config,
+                    # Disaggregated pool membership ("prefill"/"decode"/
+                    # None=fused): rides the routing table so routers
+                    # and the status surfaces see the split.
+                    "pool_role": pool_role,
                     # autoscaler bookkeeping: when the load first crossed
                     # the scale-up/-down threshold (None = not crossed)
                     "over_since": None,
@@ -395,6 +405,7 @@ class ServeController:
                     "replicas": [h for (_aid, h) in d["replicas"]],
                     "route_prefix": d["route_prefix"],
                     "max_concurrent_queries": d["max_concurrent_queries"],
+                    "pool_role": d.get("pool_role"),
                     "loads": {
                         aid: self._load_row(s)
                         for aid, s in (d.get("replica_load") or {}).items()
@@ -472,6 +483,7 @@ class ServeController:
                     "starting_replicas": len(d.get("starting", [])),
                     "draining_replicas": len(d.get("draining", [])),
                     "route_prefix": d["route_prefix"],
+                    "pool_role": d.get("pool_role"),
                     "autoscaling": d.get("autoscaling"),
                     # Last stats probe per routable replica (short id →
                     # payload): serve.status() shows live load inline.
@@ -968,6 +980,39 @@ class ServeController:
             # the lock, never on deploy/scale-scoped passes).
             self._retire_load_series()
             self._run_autoscale()
+            self._sweep_kv_orphans()
+
+    def _sweep_kv_orphans(self) -> None:
+        """Orphan-page sweep (serve/kv_objects.py): free donated KV
+        page-set objects whose donor replica is no longer a member of
+        any deployment — a SIGKILLed donor never releases its owned
+        refs, so without this its pages leak the node store — plus
+        anything past `serve_kv_object_ttl_s`. Cadence-gated; never
+        under the lock (GCS index scan + frees are RPCs)."""
+        now = time.monotonic()
+        interval = getattr(self._cfg, "serve_kv_sweep_interval_s", 10.0)
+        if now - self._kv_sweep_last < interval:
+            return
+        self._kv_sweep_last = now
+        with self._lock:
+            live = {aid
+                    for d in self.deployments.values()
+                    for aid, _h in d["replicas"]}
+            live |= {aid
+                     for d in self.deployments.values()
+                     for aid, _h, _t in d.get("starting", [])}
+            live |= {ent["aid"]
+                     for d in self.deployments.values()
+                     for ent in d.get("draining", [])}
+        try:
+            from ray_tpu import api as _api
+            from ray_tpu.serve import kv_objects
+
+            kv_objects.sweep_cluster(
+                _api._ensure_client(), live,
+                getattr(self._cfg, "serve_kv_object_ttl_s", 120.0))
+        except Exception as e:  # noqa: BLE001 — next pass retries
+            logger.debug("kv orphan sweep failed: %s", e)
 
     # ------------------------------------------- decision-plane history
 

@@ -75,6 +75,14 @@ _REQS_SHED = profiling.Counter(
     "serve_requests_shed_total",
     description="Ingress requests shed under pinned-at-max overload",
     tag_keys=("route",))
+# Disaggregated-pool handoffs (prefill → decode): the NORMAL path of a
+# split deployment — counted separately from failovers because a
+# handoff is not a failure and never spends the failover budget.
+_HANDOFFS = profiling.Counter(
+    "serve_handoffs_total",
+    description="Streams handed off from a prefill-pool replica to its "
+                "decode pool",
+    tag_keys=("route",))
 
 
 def _shed_body(shed: dict) -> bytes:
@@ -108,6 +116,22 @@ def failover_mode(e: BaseException) -> str | None:
     if any(m in s for m in _DRAIN_MARKERS):
         return "drain"
     return None
+
+
+def absorb_handoff(hand: dict | None, carry: dict) -> str | None:
+    """THE one copy of the handoff-record field transfer (async proxy
+    SSE/unary, threaded proxy, and DeploymentHandle.stream all route
+    through it — a fifth hand-rolled copy would drift): fold the donor's
+    resume context — KV page-set descriptor + memoized hash chain — into
+    `carry`, the dict every subsequent resubmit payload is updated with.
+    → the destination deployment for a POOL handoff, else None."""
+    hand = hand or {}
+    if hand.get("kv"):
+        carry["kv"] = hand["kv"]
+    if hand.get("prefix_hashes"):
+        carry["prefix_hashes"] = hand["prefix_hashes"]
+        carry["prefix_chunk"] = hand.get("prefix_chunk", 0)
+    return hand.get("deployment")
 
 
 def confirmed_dead(e: BaseException) -> bool:
@@ -498,19 +522,27 @@ class HTTPProxy(_RouterMixin):
         _QUEUE_WAIT.observe(time.time() - t0, tags={"route": name})
         return replica
 
-    async def _call_unary(self, name: str, handle, payload):
+    async def _call_unary(self, name: str, handle, payload, _hops: int = 0):
         """One request → one replica, with bounded failover: a replica
         death (ActorDiedError out of the dispatch/await) or drain
         rejection retries immediately against a re-picked replica before
         the client sees any error. The unary path delivers nothing until
         completion, so a full re-run is side-effect-safe. Prefix
-        affinity steers the FIRST pick only — retries re-pick by load."""
+        affinity steers the FIRST pick only — retries re-pick by load.
+
+        A prefill-pool replica answers with a HANDOFF envelope instead
+        of a result ({"handoff": {deployment, kv, ...}, generated_ids,
+        ...}): the request continues on the decode pool with the
+        already-produced tokens teacher-forced and the page-set
+        descriptor attached, so the decode replica adopts the donated
+        pages instead of re-prefilling. Bounded hops guard against a
+        misconfigured pool ring."""
         key = handle.affinity_key(payload)
         for attempt in range(self._failover_attempts + 1):
             replica = await self._pick(name, handle, key)
             try:
                 ref = handle.dispatch(replica, "__call__", (payload,), {})
-                return await self._await_ref(ref)
+                result = await self._await_ref(ref)
             except Exception as e:  # noqa: BLE001 — classified below
                 mode = failover_mode(e)
                 if mode is None or attempt >= self._failover_attempts:
@@ -523,6 +555,30 @@ class HTTPProxy(_RouterMixin):
                 key = None
                 _FAILOVERS.inc(1.0, tags={"route": name,
                                           "mode": f"unary_{mode}"})
+                continue
+            hand = (result.get("handoff")
+                    if isinstance(result, dict) else None)
+            carry: dict = {}
+            peer = absorb_handoff(hand, carry)
+            if peer is not None:
+                if _hops >= 2:
+                    # A pool ring (decode pool misconfigured as another
+                    # prefill pool) must fail LOUDLY — returning the
+                    # raw handoff envelope would hand the client an
+                    # internal protocol record as a 200.
+                    raise RuntimeError(
+                        "pool handoff loop: request still migrating "
+                        f"after {_hops} hops (check pool_role/"
+                        "pool_peer wiring)")
+                _HANDOFFS.inc(1.0, tags={"route": name})
+                payload2 = dict(payload)
+                payload2.update(carry)
+                payload2["generated_ids"] = result.get(
+                    "generated_ids") or []
+                payload2["request_id"] = result.get("request_id")
+                return await self._call_unary(
+                    peer, self._handle(peer), payload2, _hops + 1)
+            return result
         raise RuntimeError("unreachable")  # loop always returns or raises
 
     async def _await_ref(self, ref):
@@ -561,12 +617,24 @@ class HTTPProxy(_RouterMixin):
         payload = {k: v for k, v in payload.items() if k != "stream"}
         emitted: list = []       # tokens already sent to the client
         attempts_left = self._failover_attempts
+        hops = 0
         headers_sent = False
         replica = None
         sid = None
+        # Resume context carried across resubmits (pool handoff, drain
+        # migration, death failover): the donor's page-set descriptor +
+        # memoized hash chain, so every destination walks the adoption
+        # ladder instead of unconditionally re-prefilling.
+        carry: dict = {}
         # Affinity steers the first placement only: a resume after
         # death/drain re-picks purely by load (PR 9 resubmit contract).
         key = handle.affinity_key(payload)
+
+        def _absorb_handoff(out) -> str | None:
+            # → destination deployment for a pool handoff, else None;
+            # either way the kv descriptor/memo join the carry context
+            # (absorb_handoff is THE one copy of the field transfer).
+            return absorb_handoff(out.get("handoff"), carry)
 
         async def _failover(mode: str, victim, dead: bool = False) -> bool:
             nonlocal attempts_left, sid, key
@@ -590,6 +658,7 @@ class HTTPProxy(_RouterMixin):
                     if sid is None:
                         replica = await self._pick(name, handle, key)
                         req = dict(payload)
+                        req.update(carry)
                         if emitted:
                             req["generated_ids"] = list(emitted)
                         sid = await self._await_ref(handle.dispatch(
@@ -642,6 +711,34 @@ class HTTPProxy(_RouterMixin):
                     break
                 if out.get("done"):
                     if out.get("migrated"):
+                        peer = _absorb_handoff(out)
+                        if peer is not None:
+                            if hops >= 4:
+                                # Pool ring: fail with the TYPED loop
+                                # error (like the unary paths) instead
+                                # of mislabeling it drain failover —
+                                # that would evict healthy replicas and
+                                # burn the budget chasing the ring.
+                                _REQS_FAILED.inc(1.0, tags={
+                                    "route": name,
+                                    "reason": "handoff_loop"})
+                                writer.write(b"data: " + json.dumps(
+                                    {"error": "pool handoff loop: "
+                                     "stream still migrating after "
+                                     f"{hops} hops (check pool_role/"
+                                     "pool_peer wiring)"}).encode()
+                                    + b"\n\n")
+                                break
+                            # Pool handoff (prefill → decode): the
+                            # NORMAL path of a split deployment — switch
+                            # to the decode pool's handle, no failover
+                            # budget spent.
+                            hops += 1
+                            handle = self._handle(peer)
+                            sid = None
+                            key = None
+                            _HANDOFFS.inc(1.0, tags={"route": name})
+                            continue
                         # Drain export: this replica's leg ended with the
                         # request unfinished — resume elsewhere.
                         if await _failover("drain", replica):
@@ -776,23 +873,52 @@ class ThreadedHTTPProxy(_RouterMixin):
                     attempts = max(
                         0, runtime_config().serve_failover_attempts)
                     key = handle.affinity_key(payload)
-                    for attempt in range(attempts + 1):
+                    hops = 0
+                    attempt = 0
+                    while True:
                         replica = handle._pick_replica(key)
                         try:
                             result = ray_tpu.get(
                                 handle.dispatch(
                                     replica, "__call__", (payload,), {}),
                                 timeout=120)
-                            break
                         except Exception as e:  # noqa: BLE001
                             mode = failover_mode(e)
                             if mode is None or attempt >= attempts:
                                 raise
+                            attempt += 1
                             handle.evict_replica(
                                 replica, dead=confirmed_dead(e))
                             key = None
                             _FAILOVERS.inc(1.0, tags={
                                 "route": name, "mode": f"unary_{mode}"})
+                            continue
+                        # Pool handoff envelope: continue on the decode
+                        # pool (sync mirror of HTTPProxy._call_unary —
+                        # the async proxy owns the canonical semantics).
+                        hand = (result.get("handoff")
+                                if isinstance(result, dict) else None)
+                        hcarry: dict = {}
+                        peer = absorb_handoff(hand, hcarry)
+                        if peer is not None:
+                            if hops >= 2:
+                                raise RuntimeError(
+                                    "pool handoff loop: request still "
+                                    f"migrating after {hops} hops "
+                                    "(check pool_role/pool_peer "
+                                    "wiring)")
+                            hops += 1
+                            _HANDOFFS.inc(1.0, tags={"route": name})
+                            payload = dict(payload)
+                            payload.update(hcarry)
+                            payload["generated_ids"] = result.get(
+                                "generated_ids") or []
+                            payload["request_id"] = result.get(
+                                "request_id")
+                            handle = proxy._handle(peer)
+                            key = None
+                            continue
+                        break
                     self._json_reply(
                         200, json.dumps({"result": result}).encode())
                 except Exception as e:

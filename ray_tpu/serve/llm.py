@@ -36,6 +36,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import logging
 import math
 import queue
 import threading
@@ -48,6 +49,8 @@ import numpy as np
 from ray_tpu import chaos as _chaos
 from ray_tpu import profiling as _profiling
 from ray_tpu import tracing
+
+logger = logging.getLogger(__name__)
 
 # Per-request serving histograms, tagged by the ingress route (from trace
 # baggage) and the replica actor serving the request; flushed to the GCS
@@ -138,6 +141,21 @@ _PREFIX_COUNTERS = {
     )
 }
 
+# KV page-set lifecycle counters (serve/kv_objects.py): donations out
+# of this engine, adoptions binding donated pages instead of
+# re-prefilling, and adoption-ladder falls to the re-prefill rung —
+# the failover-cost split the disaggregated-serving bench reads.
+_KV_COUNTERS = {
+    name: _profiling.Counter(
+        f"llm_kv_{name}_total", description=desc, tag_keys=("replica",))
+    for name, desc in (
+        ("donations", "KV page-set objects donated to the object store"),
+        ("adoptions", "Admissions that adopted donated KV pages"),
+        ("adopt_failures",
+         "Adoption attempts that fell to the re-prefill rung"),
+    )
+}
+
 
 def _request_metric_tags() -> dict:
     """Route (ingress baggage) + replica (runtime context) tags for the
@@ -170,6 +188,17 @@ def _observe_request_metrics(req: "GenRequest", tags: dict) -> None:
         if decode_s > 0:
             _DECODE_HIST.observe((len(req.out_ids) - 1) / decode_s,
                                  tags=tags)
+
+
+def _pow2_width(n: int) -> int:
+    """Smallest power of two >= max(1, n): THE width-bucketing rule for
+    fused page dispatches — COW pair batches, donation gathers,
+    adoption scatters, and the decode table view all share it, so their
+    compiled-program width buckets cannot silently diverge."""
+    width = 1
+    while width < n:
+        width *= 2
+    return width
 
 
 def _ring_pctls(ring) -> tuple[float, float]:
@@ -274,6 +303,23 @@ class GenRequest:
     # the chain is parent-chained, so a page-blocked request re-scanned
     # every admission round hashes each chunk once, not once per tick.
     prefix_hashes: list = dataclasses.field(default_factory=list)
+    # KV page-set adoption hint (serve/kv_objects.py): descriptor from a
+    # donor's handoff/export ({"keys", "chunk", "page_size",
+    # "fingerprint", "n_tokens"}) — admission tries the adoption ladder
+    # against it before cold prefill. None = no hint (cold path).
+    kv: dict | None = None
+    # Memoized adoption plan (resolved ONCE per request): a page-blocked
+    # request is re-scanned every admission round, and re-resolving the
+    # digest chain against the cluster index each time would put one
+    # blocking GCS RPC per chain depth inside the engine tick. A cached
+    # plan can go stale (entries swept mid-wait) — the bind's fetch
+    # failures walk the ladder down, so staleness costs a rung, never
+    # correctness.
+    kv_plan: dict | None = None
+    kv_plan_tried: bool = False
+    # Set when THIS request's pages were donated on handoff/export: the
+    # descriptor the consumer forwards to the next replica.
+    kv_handoff: dict | None = None
     out_ids: list[int] = dataclasses.field(default_factory=list)
     truncated: bool = False   # finished early (capacity/unresumable preempt)
     # Exported off a draining/dying engine as a resumable continuation:
@@ -305,7 +351,9 @@ class LLMEngine:
                  prefix_cache: bool | None = None,
                  prefix_cache_pages: int | None = None,
                  spec_draft=None, spec_k: int | None = None,
-                 spec_draft_params=None, tp: int | None = None):
+                 spec_draft_params=None, tp: int | None = None,
+                 pool_role: str | None = None,
+                 kv_transfer: bool | None = None, kv_store=None):
         import types
 
         import jax
@@ -343,6 +391,8 @@ class LLMEngine:
             decode_multi_paged=_w(_paged.decode_multi_paged,
                                   "decode_multi_paged"),
             copy_pages=_w(_paged.copy_pages, "copy_pages"),
+            gather_pages=_w(_paged.gather_pages, "gather_pages"),
+            scatter_pages=_w(_paged.scatter_pages, "scatter_pages"),
             verify_chunk_paged=_w(_paged.verify_chunk_paged,
                                   "verify_chunk_paged"),
             spec_draft_propose=_w(_paged.spec_draft_propose,
@@ -364,10 +414,12 @@ class LLMEngine:
         cache_explicit = prefix_cache is not None
         spec_explicit = spec_draft is not None
         tp_explicit = tp is not None
+        kv_explicit = kv_transfer is not None
         if (kv_mode is None or page_size is None or attn_impl is None
                 or prefill_chunk is None or prefill_token_budget is None
                 or prefix_cache is None or prefix_cache_pages is None
-                or spec_draft is None or spec_k is None or tp is None):
+                or spec_draft is None or spec_k is None or tp is None
+                or kv_transfer is None):
             from ray_tpu.core.config import runtime_config
 
             _rc = runtime_config()
@@ -390,6 +442,8 @@ class LLMEngine:
                           else spec_draft)
             spec_k = _rc.llm_spec_k if spec_k is None else spec_k
             tp = _rc.llm_tp if tp is None else tp
+            kv_transfer = (_rc.llm_kv_transfer if kv_transfer is None
+                           else kv_transfer)
         if prefill_chunk and kv_mode != "paged" and not chunk_explicit:
             # The global llm_prefill_chunk knob applies to paged engines;
             # a dense engine alongside it just keeps one-shot admission
@@ -522,6 +576,53 @@ class LLMEngine:
                     f"({draft_cfg.n_heads}) and d_ff ({draft_cfg.d_ff}) "
                     "— the draft pool shards along the same head axis")
         self.tp = tp
+        # Disaggregated serving (serve/kv_objects.py): pool_role splits
+        # replicas into a PREFILL pool — which runs a prompt's prefill,
+        # emits the first token, donates the written KV pages as
+        # page-set objects, and hands the stream off — and a DECODE pool
+        # that ADOPTS the donated pages by reference instead of
+        # re-prefilling. kv_transfer alone (no role) enables the same
+        # donate/adopt machinery on a fused engine: completed requests
+        # donate, and failover resumes adopt when the refs resolve.
+        # Validation pattern from llm_prefill_chunk: the GLOBAL
+        # llm_kv_transfer knob soft-disables on any misfit so a
+        # fleet-wide export can't crash replica boot; explicit
+        # constructor args raise typed errors.
+        if pool_role not in (None, "", "prefill", "decode"):
+            raise ValueError(
+                f"pool_role must be None|'prefill'|'decode', "
+                f"got {pool_role!r}")
+        pool_role = pool_role or None
+        if pool_role is not None and kv_explicit and not kv_transfer:
+            raise ValueError(
+                f"pool_role={pool_role!r} requires kv_transfer — the "
+                "prefill→decode handoff IS a page-set donation + "
+                "adoption")
+        if pool_role is not None:
+            kv_transfer = True
+        if kv_transfer and not (kv_mode == "paged" and prefill_chunk
+                                and prefill_chunk % page_size == 0
+                                and tp == 1):
+            # chunk % page_size == 0 is load-bearing, not cosmetic:
+            # page-set entries are deduped per chain DEPTH across
+            # donations, and with page-aligned chunks every depth's
+            # span is self-contained. A mid-page chunk boundary would
+            # let a chain compose depths from DIFFERENT donations whose
+            # shared boundary page only one of them fully wrote —
+            # adopting it would serve garbage KV for the boundary
+            # positions and silently break byte-exactness.
+            if kv_explicit or pool_role is not None:
+                raise ValueError(
+                    "KV page-set transfer requires kv_mode='paged', "
+                    "prefill_chunk > 0 with prefill_chunk % page_size "
+                    "== 0 (cross-donation dedup needs page-aligned "
+                    "chain depths), and tp == 1 (payloads are "
+                    f"unsharded page planes); got kv_mode={kv_mode!r}, "
+                    f"prefill_chunk={prefill_chunk}, "
+                    f"page_size={page_size}, tp={tp}")
+            kv_transfer = False
+        self.pool_role = pool_role
+        self.kv_transfer = bool(kv_transfer)
         self.kv_mode = kv_mode
         # Paged-decode attention path (models/paged_kv.py): "kernel" = the
         # Pallas ragged paged-attention kernel, "gather" = the exact-match
@@ -652,6 +753,39 @@ class LLMEngine:
                 chunk=prefill_chunk, page_size=page_size,
                 max_pages=budget, ref_page=self._ref_page,
                 unref_page=self._unref_page)
+        # KV page-set store (serve/kv_objects.py): donation target +
+        # adoption source. Backend selection gates on an ALREADY
+        # attached client (never _ensure_client — constructing an
+        # engine off-cluster must not boot a cluster); off-cluster
+        # engines share the process-global LocalKVStore so in-process
+        # donor/adopter pairs exercise the full ladder in unit tests.
+        self._kv_store = None
+        self._kv_fingerprint = ""
+        self._kv_donor = ""
+        # page -> refs held by an IN-FLIGHT donation (device gather +
+        # store put): the "in-flight-donated" category of the page-
+        # accounting closure (free + live + cached + exporting-only
+        # == total), rolled back in a finally so a chaos raise at
+        # serve.kv.donate can't leak a reference.
+        self._kv_exporting: dict[int, int] = {}
+        if self.kv_transfer:
+            import os as _os
+
+            from ray_tpu.serve import kv_objects as _kvo
+
+            self._kvo = _kvo
+            try:
+                from ray_tpu import api as _api
+
+                aid = _api.get_runtime_context().get_actor_id()
+            except Exception:  # graftlint: disable=EXC-SWALLOW (outside an actor: the pid-based donor id below is the designed fallback)
+                aid = None
+            self._kv_donor = aid or f"local:{_os.getpid()}"
+            self._kv_store = (kv_store if kv_store is not None
+                              else _kvo.get_store(donor=self._kv_donor))
+            self._kv_fingerprint = _kvo.engine_fingerprint(
+                cfg, page_size, prefill_chunk,
+                draft_cfg if spec_draft else None)
         # slot -> pinned CacheEntry while the slot is live (released on
         # free/preempt), and the tick's pending COW (src, dst) pairs,
         # flushed in one fused device copy per tick (_apply_cow).
@@ -753,7 +887,15 @@ class LLMEngine:
                       # accept path (accepted + correction/bonus).
                       "spec_proposed": 0, "spec_accepted": 0,
                       "spec_ticks": 0, "spec_slot_steps": 0,
-                      "spec_emitted": 0}
+                      "spec_emitted": 0,
+                      # KV page-set transfer (zeros unless enabled):
+                      # donations/pages leaving this engine, adoptions
+                      # (full + partial) binding donated pages instead
+                      # of re-prefilling, tokens served from adopted
+                      # pages, and ladder falls to the re-prefill rung.
+                      "kv_donations": 0, "kv_donated_pages": 0,
+                      "kv_adoptions": 0, "kv_partial_adoptions": 0,
+                      "kv_adopted_tokens": 0, "kv_adopt_failures": 0}
 
     # ------------------------------------------------------------- API
 
@@ -761,7 +903,10 @@ class LLMEngine:
                temperature: float = 0.0, eos_id: int | None = None,
                stream: bool = False,
                generated_ids: list[int] | None = None,
-               request_id: str | None = None) -> GenRequest:
+               request_id: str | None = None,
+               kv: dict | None = None,
+               prefix_hashes: list | None = None,
+               prefix_chunk: int = 0) -> GenRequest:
         """Queue one generation request.
 
         `generated_ids` resumes a continuation migrated off another
@@ -771,6 +916,16 @@ class LLMEngine:
         stream cursor splices exactly), and are never re-emitted. Same
         math as the in-replica preempt-by-recompute path, so a greedy
         continuation is byte-identical to the uninterrupted run.
+
+        `kv` is a donor's page-set descriptor (handoff / drain export):
+        admission walks the adoption ladder against it — adopt the
+        donated pages if the refs resolve, partial-adopt a surviving
+        prefix, else fall through to the teacher-forced re-prefill
+        above. `prefix_hashes` (+ `prefix_chunk`, the granularity they
+        were computed at) seeds the request's memoized chunk-hash chain
+        from the source replica's export, so a resumed continuation
+        never re-hashes its full context; a memo at a different chunk
+        granularity is silently dropped (wrong key space).
         """
         # An empty prompt has no last-token logits to sample from: the
         # one-shot path would emit an arbitrary token, the chunked path
@@ -799,6 +954,18 @@ class LLMEngine:
             out_ids=generated,
             stream=queue.Queue() if stream else None,
         )
+        if (prefix_hashes and self.prefill_chunk
+                and prefix_chunk == self.prefill_chunk):
+            try:
+                req.prefix_hashes = [
+                    bytes.fromhex(h) if isinstance(h, str) else bytes(h)
+                    for h in prefix_hashes]
+            except (ValueError, TypeError):
+                # A malformed memo is only a lost optimization — the
+                # chain rebuilds from the tokens.
+                req.prefix_hashes = []
+        if kv and self._kv_store is not None:
+            req.kv = dict(kv)
         if generated and (
                 len(generated) >= max_tokens
                 or (eos_id is not None and generated[-1] == eos_id)):
@@ -915,11 +1082,14 @@ class LLMEngine:
         if self._thread is not None:
             self.stop()
         doomed: list[GenRequest] = []
+        slot_of: dict[int, GenRequest] = {}
         with self._lock:
             for slot, req in enumerate(self.slot_req):
                 if req is not None:
                     doomed.append(req)
+                    slot_of[slot] = req
                     self.slot_req[slot] = None
+            chunk_pos = dict(self._chunk_pos)
             self._prefilling.clear()
             self._chunk_pos.clear()
             doomed.extend(self._deferred)
@@ -933,8 +1103,27 @@ class LLMEngine:
             # The engine thread is stopped: return every evicted slot's
             # pages (decrement-only — prefix-cache entries keep theirs,
             # so a drained-but-not-killed engine still closes the page
-            # accounting: free + cached == total).
+            # accounting: free + cached == total). With KV transfer on,
+            # each slot's WRITTEN prefix is donated to the page-set
+            # store FIRST — the destination replica adopts those pages
+            # instead of re-prefilling the teacher-forced context (the
+            # drain rung of the adoption ladder).
             for slot in range(self.n_slots):
+                req = slot_of.get(slot)
+                if (req is not None and self._kv_store is not None
+                        and int(self.slot_n_pages[slot])):
+                    n_written = int(self.positions[slot])
+                    if n_written <= 0:
+                        n_written = int(chunk_pos.get(slot, 0))
+                    # True written sequence (see the matching comment
+                    # in _release): anchored at n_prompt so a preempt-
+                    # regrown context can't duplicate generated tokens
+                    # into the donation keys.
+                    seq = (req.prompt_ids[:req.n_prompt]
+                           + req.out_ids)[:n_written]
+                    req.kv_handoff = self._donate_kv(
+                        seq, self.page_table[slot],
+                        memo=req.prefix_hashes)
                 entry = self._slot_entry.pop(slot, None)
                 if entry is not None:
                     self.prefix_cache.release(entry)
@@ -944,7 +1133,7 @@ class LLMEngine:
                 self.tokens[slot] = 0
         out = []
         for req in doomed:
-            out.append({
+            cont = {
                 "request_id": req.request_id,
                 # prompt_ids may have regrown past n_prompt on preempt
                 # (context = prompt + generated); split so the consumer
@@ -954,7 +1143,19 @@ class LLMEngine:
                 "max_tokens": req.max_tokens,
                 "temperature": req.temperature,
                 "eos_id": req.eos_id,
-            })
+            }
+            if self.prefill_chunk and req.prefix_hashes:
+                # The memoized chunk-hash chain rides the continuation
+                # (hex — JSON-safe), so the destination replica never
+                # re-hashes the full context on resume; prefix_chunk
+                # lets a differently-configured destination drop an
+                # incompatible memo instead of poisoning its key space.
+                cont["prefix_hashes"] = [h.hex()
+                                         for h in req.prefix_hashes]
+                cont["prefix_chunk"] = self.prefill_chunk
+            if req.kv_handoff is not None:
+                cont["kv"] = req.kv_handoff
+            out.append(cont)
             req.migrated = True
             if req.stream is not None:
                 req.stream.put(None)
@@ -1081,6 +1282,9 @@ class LLMEngine:
                 if m["spec_proposed"]:
                     m["spec_accept_rate"] = round(
                         m["spec_accepted"] / m["spec_proposed"], 4)
+            if self.kv_transfer:
+                m["kv_transfer"] = True
+                m["pool_role"] = self.pool_role or "fused"
             if self.prefix_cache is not None:
                 m["prefix_cache"] = True
                 m["prefix_cache_entries"] = len(self.prefix_cache.entries)
@@ -1189,6 +1393,20 @@ class LLMEngine:
                 if self._spec_accept_ewma is not None:
                     snap["spec_accepted_per_step"] = round(
                         self._spec_accept_ewma, 4)
+            if self.kv_transfer:
+                # Pool role + adoption/donation counts ride the PR 6
+                # chain as-is: Replica.stats() → controller probe →
+                # serve.status() / /api/serve/load / the CLI render —
+                # the disaggregation observability surface.
+                snap["pool_role"] = self.pool_role or "fused"
+                snap["kv_donations"] = self.stats["kv_donations"]
+                snap["kv_adoptions"] = self.stats["kv_adoptions"]
+                snap["kv_partial_adoptions"] = (
+                    self.stats["kv_partial_adoptions"])
+                snap["kv_adopted_tokens"] = (
+                    self.stats["kv_adopted_tokens"])
+                snap["kv_adopt_failures"] = (
+                    self.stats["kv_adopt_failures"])
             if self.prefix_cache is not None:
                 # Cached-pages + hit-rate ride the same probe chain as
                 # the rest of the load snapshot: Replica.stats() →
@@ -1297,6 +1515,241 @@ class LLMEngine:
         self.page_table[slot, :] = 0
         self.slot_n_pages[slot] = 0
 
+    # ------------------------------------------- KV page-set transfer
+
+    def _donate_kv(self, seq, table_row, memo: list) -> dict | None:
+        """Donate the chunk-aligned written prefix of ``seq`` (its K/V
+        already sits in ``table_row``'s pages) to the page-set store as
+        one entry per chain depth, keyed by the SAME parent-chained
+        digests the prefix cache uses. Pages are reffed for the
+        duration of the device gather + store put (the in-flight-
+        donated accounting category) and released in a finally, so a
+        chaos raise at serve.kv.donate can't leak a reference. Best-
+        effort by contract: any failure returns what was resolvable and
+        never fails the completing request. → adoption descriptor for
+        the continuation consumer, or None."""
+        if self._kv_store is None:
+            return None
+        from ray_tpu.serve.prefix_cache import extend_chunk_chain
+
+        c = self.prefill_chunk
+        n_full = len(seq) // c
+        if n_full <= 0:
+            return None
+        chain = extend_chunk_chain(seq, c, memo if memo is not None else [])
+        keys = [h.hex() for h in chain[:n_full]]
+        total_pages = self._kvo.pages_for_tokens(n_full * c, self.page_size)
+        pages = [int(table_row[i]) for i in range(total_pages)]
+        if any(p <= 0 for p in pages):
+            # Defensive (mirrors PrefixCache.donate): a donor must own
+            # real pages for every token it claims to have written.
+            return None
+        desc = {"keys": keys, "chunk": c, "page_size": self.page_size,
+                "fingerprint": self._kv_fingerprint,
+                "n_tokens": n_full * c}
+        try:
+            # Chaos fault point: EVERY donation attempt (not just novel
+            # digests — the store dedups those) — a "kill" rule here is
+            # the donor-SIGKILL-mid-donation scenario, a "raise" skips
+            # this donation while the engine keeps serving.
+            _chaos.hit("serve.kv.donate")
+            existing = self._kv_store.resolve(keys)
+        except Exception as e:  # noqa: BLE001 — index blip / chaos:
+            # skip donation, the descriptor still names the keys.
+            logger.debug("kv donation skipped: %s", e)
+            return desc
+        new_depths = [d for d in range(1, n_full + 1)
+                      if keys[d - 1] not in existing]
+        if not new_depths:
+            return desc
+        for p in pages:
+            self._ref_page(p)
+            self._kv_exporting[p] = self._kv_exporting.get(p, 0) + 1
+        tags = {"replica": self._impl_tags()["replica"]}
+        try:
+            rt = self._rt
+            width = _pow2_width(total_pages)
+            ids = np.zeros(width, np.int32)
+            ids[:total_pages] = pages
+            gathered = rt.gather_pages(self.cache, rt.jnp.asarray(ids))
+            k_host = np.asarray(gathered["k"])
+            v_host = np.asarray(gathered["v"])
+            dk_host = dv_host = None
+            if self.spec_k:
+                # Draft pool mirror: draft page p ≡ target page p, so
+                # donations carry both and an adopting spec engine keeps
+                # the mirror exact (a spec adopter REQUIRES the draft
+                # planes — see _kv_adopt_plan).
+                dg = rt.gather_pages(self.draft_cache, rt.jnp.asarray(ids))
+                dk_host = np.asarray(dg["k"])
+                dv_host = np.asarray(dg["v"])
+            for d in new_depths:
+                s, e = self._kvo.page_span(d, c, self.page_size)
+                payload = {"k": k_host[:, s:e], "v": v_host[:, s:e]}
+                if dk_host is not None:
+                    payload["dk"] = dk_host[:, s:e]
+                    payload["dv"] = dv_host[:, s:e]
+                meta = self._kvo.make_meta(
+                    keys[d - 1], d, c, self.page_size,
+                    self._kv_fingerprint, self._kv_donor, e - s,
+                    bool(self.spec_k))
+                self._kv_store.donate(meta, payload)
+                self.stats["kv_donations"] += 1
+                self.stats["kv_donated_pages"] += e - s
+                _KV_COUNTERS["donations"].inc(tags=tags)
+        except Exception as e:  # noqa: BLE001 — incl. ChaosError: the
+            # donor keeps serving; already-published depths stay usable.
+            logger.debug("kv donation aborted mid-chain: %s", e)
+        finally:
+            for p in pages:
+                n = self._kv_exporting.get(p, 0) - 1
+                if n <= 0:
+                    self._kv_exporting.pop(p, None)
+                else:
+                    self._kv_exporting[p] = n
+                self._unref_page(p)
+        return desc
+
+    def _kv_adopt_plan(self, req: GenRequest,
+                       n_local: int) -> dict | None:
+        """Resolve the deepest contiguous donated chain prefix for
+        ``req``'s context, deeper than the local prefix-cache match
+        ``n_local`` (local sharing is zero-copy — adoption only wins
+        when it covers MORE tokens). Walks depth 1 upward: a missing or
+        incompatible entry stops the walk, so a dead donor's partially
+        swept chain degrades to partial adoption, never a wrong bind."""
+        if self._kv_store is None or not req.kv:
+            return None
+        kv = req.kv
+        if (kv.get("fingerprint") != self._kv_fingerprint
+                or kv.get("chunk") != self.prefill_chunk
+                or kv.get("page_size") != self.page_size):
+            return None
+        from ray_tpu.serve.prefix_cache import extend_chunk_chain
+
+        cap = (len(req.prompt_ids) - 1) // self.prefill_chunk
+        if cap <= 0:
+            return None
+        chain = extend_chunk_chain(req.prompt_ids, self.prefill_chunk,
+                                   req.prefix_hashes)
+        keys = [h.hex() for h in chain[:cap]]
+        try:
+            found = self._kv_store.resolve(keys)
+        except Exception as e:  # noqa: BLE001 — index blip = cold path
+            logger.debug("kv adoption resolve failed: %s", e)
+            return None
+        metas = []
+        for d in range(1, cap + 1):
+            meta = found.get(keys[d - 1])
+            if (meta is None
+                    or meta.get("fingerprint") != self._kv_fingerprint
+                    or meta.get("chunk") != self.prefill_chunk
+                    or meta.get("page_size") != self.page_size
+                    or (self.spec_k and not meta.get("draft"))):
+                break
+            metas.append(meta)
+        if not metas or len(metas) * self.prefill_chunk <= n_local:
+            return None
+        return {"n_tokens": len(metas) * self.prefill_chunk,
+                "metas": metas}
+
+    def _bind_kv_adopt(self, slot: int, req: GenRequest,
+                       plan: dict) -> int:
+        """Adoption bind: fetch the planned page-set payloads (deepest
+        contiguous run that transfers — serve.kv.adopt chaos drops a
+        rung here), allocate fresh exclusive pages, scatter the
+        payloads into the pool in one fused dispatch (+ the draft-pool
+        mirror when speculative decoding is on), and bind them into
+        ``slot``'s table like a local warm hit. The chunk cursor starts
+        at the first cold token. → adopted tokens (0 = ladder fell
+        through to re-prefill)."""
+        tags = {"replica": self._impl_tags()["replica"]}
+        payloads: list[dict] = []
+        for meta in plan["metas"]:
+            try:
+                p = self._kv_store.fetch(meta)
+                if (p["k"].shape[1] != meta["n_pages"]
+                        or (self.spec_k and "dk" not in p)):
+                    raise ValueError("kv payload shape mismatch")
+                payloads.append(p)
+            except Exception as e:  # noqa: BLE001 — transfer failed:
+                # adopt the depths that DID arrive (partial rung).
+                logger.debug("kv fetch of depth %s failed: %s",
+                             meta.get("depth"), e)
+                break
+        if not payloads:
+            self.stats["kv_adopt_failures"] += 1
+            _KV_COUNTERS["adopt_failures"].inc(tags=tags)
+            return 0
+        n_adopt = len(payloads) * self.prefill_chunk
+        n_pages = self._pages_for(n_adopt - 1)
+        if n_pages > len(self.free_pages):
+            self._cache_reclaim(n_pages)
+        alloc: list[int] = []
+        for _ in range(n_pages):
+            pg = self._alloc_page()
+            if pg is None:
+                break
+            alloc.append(pg)
+        if len(alloc) < n_pages:
+            # Pool dry mid-bind (reservation shortfall): roll back — a
+            # partial page run can't serve the adopted prefix.
+            for pg in alloc:
+                self._unref_page(pg)
+            self.stats["kv_adopt_failures"] += 1
+            _KV_COUNTERS["adopt_failures"].inc(tags=tags)
+            return 0
+        rt = self._rt
+        k_data = np.concatenate([p["k"] for p in payloads], axis=1)
+        v_data = np.concatenate([p["v"] for p in payloads], axis=1)
+        width = _pow2_width(n_pages)
+        ids = np.zeros(width, np.int32)
+        ids[:n_pages] = alloc
+        if width > n_pages:
+            pad = ((0, 0), (0, width - n_pages)) + ((0, 0),) * 3
+            k_data = np.pad(k_data, pad)
+            v_data = np.pad(v_data, pad)
+        self.cache = rt.scatter_pages(
+            self.cache, rt.jnp.asarray(ids), rt.jnp.asarray(k_data),
+            rt.jnp.asarray(v_data))
+        if self.spec_k:
+            dk = np.concatenate([p["dk"] for p in payloads], axis=1)
+            dv = np.concatenate([p["dv"] for p in payloads], axis=1)
+            if width > n_pages:
+                dk = np.pad(dk, pad)
+                dv = np.pad(dv, pad)
+            self.draft_cache = rt.scatter_pages(
+                self.draft_cache, rt.jnp.asarray(ids),
+                rt.jnp.asarray(dk), rt.jnp.asarray(dv))
+        for i, pg in enumerate(alloc):
+            self.page_table[slot, i] = pg
+        self.slot_n_pages[slot] = n_pages
+        req.cached_tokens = n_adopt
+        self.stats["kv_adoptions"] += 1
+        self.stats["kv_adopted_tokens"] += n_adopt
+        if len(payloads) < len(plan["metas"]):
+            self.stats["kv_partial_adoptions"] += 1
+        _KV_COUNTERS["adoptions"].inc(tags=tags)
+        return n_adopt
+
+    def _handoff_prefill(self, slot: int, req: GenRequest) -> None:
+        """Prefill-pool handoff (pool_role='prefill'): the prompt's KV
+        pages are donated and the request leaves this replica as a
+        migrated continuation the moment its first token is out — the
+        consumer (proxy / handle stream) resubmits
+        ``(prompt, [first token], kv descriptor)`` to a decode-pool
+        replica, which adopts the pages instead of re-prefilling. Same
+        migration contract as drain export, so greedy streams stay
+        byte-identical across the handoff."""
+        req.kv_handoff = self._donate_kv(
+            req.prompt_ids, self.page_table[slot],
+            memo=req.prefix_hashes)
+        req.migrated = True
+        if req.stream is not None:
+            req.stream.put(None)
+        req.done.set()
+        self._release(slot)
+
     def page_accounting(self) -> dict:
         """Closure check (tests + chaos triage): every pool page is
         exactly one of free / referenced, and every reference is owned
@@ -1309,11 +1762,18 @@ class LLMEngine:
                 live[pg] = live.get(pg, 0) + 1
         cached = (self.prefix_cache.cached_pages()
                   if self.prefix_cache is not None else set())
-        allocated = set(live) | cached
+        # In-flight-donated: pages reffed by a KV page-set donation in
+        # progress (device gather + store put). Between ticks this is
+        # empty — a chaos kill/raise mid-donation is exactly when the
+        # closure (free + live + cached + in-flight-donated == total)
+        # must still hold.
+        exporting = dict(self._kv_exporting)
+        allocated = set(live) | cached | set(exporting)
         refs_ok = all(
-            int(self.page_refs[pg]) == live.get(pg, 0) + (
-                self.prefix_cache.page_refs_held(pg)
-                if self.prefix_cache is not None else 0)
+            int(self.page_refs[pg]) == live.get(pg, 0)
+            + (self.prefix_cache.page_refs_held(pg)
+               if self.prefix_cache is not None else 0)
+            + exporting.get(pg, 0)
             for pg in allocated)
         free = len(self.free_pages)
         return {
@@ -1322,6 +1782,7 @@ class LLMEngine:
             "live": len(live),
             "cached": len(cached),
             "cached_only": len(cached - set(live)),
+            "exporting": len(exporting),
             "shared": sum(1 for pg in live if live[pg] > 1 or pg in cached),
             "closure": free + len(allocated) == self.n_pages,
             "refs_consistent": refs_ok and not (
@@ -1435,6 +1896,7 @@ class LLMEngine:
         reqs: list[GenRequest] = []
         blocked: list[GenRequest] = []
         hits: dict[str, Any] = {}
+        plans: dict[str, dict] = {}
         head_mark = 0
         planned_pages = 0
         while len(reqs) < len(free):
@@ -1467,15 +1929,33 @@ class LLMEngine:
                             req.prompt_ids, memo=req.prefix_hashes)
                         if hit is not None:
                             n_cached = hit.n_tokens
-                    end = min(n_cached + self.prefill_chunk,
-                              len(req.prompt_ids))
-                    need = (self._pages_for(end - 1)
-                            - n_cached // self.page_size)
+                    if not req.kv_plan_tried:
+                        req.kv_plan = self._kv_adopt_plan(req, n_cached)
+                        req.kv_plan_tried = True
+                    plan = req.kv_plan
+                    if plan is not None:
+                        # Adoption ladder rung 1: donated pages resolve
+                        # DEEPER than any local warm hit. Adopted pages
+                        # are fresh exclusive allocations (nothing is
+                        # shared across replicas), so the reservation
+                        # covers the whole adopted run + first cold
+                        # chunk — the bind may still degrade (partial /
+                        # re-prefill) without exceeding it.
+                        plans[req.request_id] = plan
+                        end = min(plan["n_tokens"] + self.prefill_chunk,
+                                  len(req.prompt_ids))
+                        need = self._pages_for(end - 1)
+                    else:
+                        end = min(n_cached + self.prefill_chunk,
+                                  len(req.prompt_ids))
+                        need = (self._pages_for(end - 1)
+                                - n_cached // self.page_size)
                 else:
                     need = self._pages_for(len(req.prompt_ids))
                 if planned_pages + need > len(self.free_pages):
                     self._cache_reclaim(planned_pages + need)
                 if planned_pages + need > len(self.free_pages):
+                    plans.pop(req.request_id, None)
                     if hit is not None:
                         # Not admitted this round: unpin (the entry is
                         # re-acquired when the request is re-scanned).
@@ -1507,9 +1987,19 @@ class LLMEngine:
             # COLD token — the cached prefix is never re-prefilled.
             for req, slot in zip(reqs, free):
                 n_cached = 0
-                if self.prefix_cache is not None:
-                    n_cached = self._bind_cached_prefix(
-                        slot, req, hits.pop(req.request_id, None))
+                hit = hits.pop(req.request_id, None)
+                plan = plans.pop(req.request_id, None)
+                if plan is not None:
+                    n_cached = self._bind_kv_adopt(slot, req, plan)
+                if n_cached:
+                    # Adopted: the pinned local entry (if any) goes
+                    # unused — release it; adoption only planned when
+                    # it covers MORE tokens than the local hit.
+                    if hit is not None:
+                        self.prefix_cache.release(hit)
+                elif self.prefix_cache is not None:
+                    # Ladder falls through: local warm hit, else cold.
+                    n_cached = self._bind_cached_prefix(slot, req, hit)
                 with self._lock:
                     self.slot_req[slot] = req
                 self.tokens[slot] = 0
@@ -1599,9 +2089,7 @@ class LLMEngine:
             return
         rt = self._rt
         pairs, self._cow_pairs = self._cow_pairs, []
-        width = 1
-        while width < len(pairs):
-            width *= 2
+        width = _pow2_width(len(pairs))
         src = np.zeros(width, np.int32)
         dst = np.zeros(width, np.int32)
         for i, (s, d) in enumerate(pairs):
@@ -1835,6 +2323,11 @@ class LLMEngine:
             self.temps[slot] = req.temperature
             if self._emit(req, tok):
                 self._release(slot)
+            elif self.pool_role == "prefill":
+                # Disaggregated serving: the prefill pool's job ends at
+                # the first token — donate the prompt's pages and hand
+                # the stream off to the decode pool.
+                self._handoff_prefill(slot, req)
 
     def _release(self, slot: int) -> None:
         """Free a slot. Positions reset so multi-step windows never walk an
@@ -1852,13 +2345,24 @@ class LLMEngine:
             self.slot_req[slot] = None
         if (self.prefix_cache is not None and req is not None
                 and req.done.is_set() and req.error is None
-                and not req.migrated):
+                and (not req.migrated or req.kv_handoff is not None)):
+            # Migrated requests normally never donate (drain export
+            # wants the pages BACK) — except a prefill-pool handoff,
+            # whose pages were just object-donated and are equally
+            # valid local warm state for the next same-prefix prompt.
             # positions[slot] counts the slot's correctly-written leading
             # positions in EVERY path (prefill graduation sets it to the
             # prompt length; each decode write advances it; a mid-window
-            # finish just leaves this conservative).
+            # finish just leaves this conservative). The written
+            # sequence is the TRUE context prompt_ids[:n_prompt] +
+            # out_ids — NOT prompt_ids + out_ids, which double-counts
+            # the pre-preempt generated tokens a regrow already folded
+            # into prompt_ids and would key pages under digests of a
+            # sequence that was never written (wrong-KV serving if a
+            # later prompt matched the stale key).
             n_written = int(self.positions[slot])
-            seq = (req.prompt_ids + req.out_ids)[:n_written]
+            seq = (req.prompt_ids[:req.n_prompt]
+                   + req.out_ids)[:n_written]
             self.prefix_cache.donate(seq, self.page_table[slot],
                                      memo=req.prefix_hashes)
             self._sync_cache_evictions()
@@ -1879,9 +2383,18 @@ class LLMEngine:
         pool and the request re-enters the queue with context = prompt +
         everything generated so far, so a later prefill rebuilds the KV
         and generation continues exactly where it stopped (out_ids is
-        preserved; _emit's budget check keeps counting against it)."""
+        preserved; _emit's budget check keeps counting against it).
+
+        The regrow is anchored at n_prompt — NOT appended to the
+        already-regrown prompt_ids — so the invariant `context ==
+        prompt_ids[:n_prompt] + out_ids` holds across ANY number of
+        preempts. Appending (the old form) duplicated the pre-preempt
+        generated tokens on the SECOND preempt, corrupting both the
+        recompute context and every digest keyed off it (pinned by
+        test_kv_objects.TestPreemptRegrow)."""
         req = self.slot_req[slot]
-        req.prompt_ids = list(req.prompt_ids) + [int(t) for t in req.out_ids]
+        req.prompt_ids = (list(req.prompt_ids[:req.n_prompt])
+                          + [int(t) for t in req.out_ids])
         self._release(slot)
         self.stats["preemptions"] += 1
         if (len(req.prompt_ids) > self._prompt_cap
@@ -1995,10 +2508,7 @@ class LLMEngine:
         mid-prefill never widens — and re-compiles — every window while
         it streams in)."""
         w = max(1, int(self.slot_n_pages[active].max()))
-        width = 1
-        while width < w:
-            width *= 2
-        width = min(width, self.max_pages_per_slot)
+        width = min(_pow2_width(w), self.max_pages_per_slot)
         view = self.page_table[:, :width]
         if self._prefilling:
             view = view.copy()
@@ -2396,7 +2906,9 @@ class LLMDeployment:
                  max_len: int = 1024, params_checkpoint: str | None = None,
                  spec_draft_checkpoint: str | None = None,
                  engine_kwargs: dict | None = None,
-                 jax_platform: str | None = None):
+                 jax_platform: str | None = None,
+                 pool_role: str | None = None,
+                 pool_peer: str | None = None):
         if jax_platform is not None:
             # Must run before this replica process's JAX backend initializes
             # (tests pin replicas to host CPU; production leaves the TPU).
@@ -2428,19 +2940,56 @@ class LLMDeployment:
 
             dck = Checkpoint.from_directory(spec_draft_checkpoint).to_dict()
             engine_kwargs["spec_draft_params"] = dck["params"]
+        # Disaggregated pools (serve_pool_role): "prefill" replicas run
+        # prompt prefill + first token, donate the KV pages, and hand
+        # the stream off to `pool_peer` — the decode deployment whose
+        # replicas adopt the pages by reference. The consumer (proxy /
+        # handle.stream) reads the peer name off the handoff record, so
+        # the engine itself stays deployment-agnostic.
+        if pool_role == "prefill" and not pool_peer:
+            raise ValueError(
+                "pool_role='prefill' requires pool_peer (the decode "
+                "deployment name the handoff resubmits to)")
+        self._pool_role = pool_role or None
+        self._pool_peer = pool_peer
+        if pool_role:
+            if engine_kwargs.get("pool_role", pool_role) != pool_role:
+                raise ValueError(
+                    "pool_role and engine_kwargs['pool_role'] disagree "
+                    f"({pool_role!r} vs {engine_kwargs['pool_role']!r})")
+            engine_kwargs["pool_role"] = pool_role
         self.engine = LLMEngine(cfg, params, n_slots=n_slots,
                                 max_len=max_len, **engine_kwargs)
         self.engine.start()
 
     def generate(self, prompt_ids: list[int], max_tokens: int = 64,
-                 temperature: float = 0.0, eos_id: int | None = None) -> dict:
+                 temperature: float = 0.0, eos_id: int | None = None,
+                 generated_ids: list[int] | None = None,
+                 kv: dict | None = None,
+                 request_id: str | None = None,
+                 prefix_hashes: list | None = None,
+                 prefix_chunk: int = 0) -> dict:
         tags = _request_metric_tags()
         req = self.engine.submit(
             prompt_ids, max_tokens=max_tokens, temperature=temperature,
-            eos_id=eos_id)
+            eos_id=eos_id, generated_ids=generated_ids, kv=kv,
+            request_id=request_id, prefix_hashes=prefix_hashes,
+            prefix_chunk=prefix_chunk)
         req.done.wait()
         _observe_request_metrics(req, tags)
         if req.migrated:
+            if self._pool_role == "prefill":
+                # Pool handoff, not an error: the caller (proxy /
+                # handle) resubmits this envelope — prompt, the tokens
+                # already produced, and the page-set descriptor — to
+                # the decode pool, which adopts instead of
+                # re-prefilling.
+                return {"handoff": self._handoff_record(req),
+                        "request_id": req.request_id,
+                        "generated_ids": [int(t) for t in req.out_ids],
+                        "max_tokens": max_tokens,
+                        "temperature": temperature,
+                        "eos_id": eos_id}
             # Drain export raced this in-flight call: the proxy/handle
             # treats "migrated"/"draining" errors as retriable-elsewhere
             # (the unary path is side-effect-free to re-run in full).
@@ -2455,6 +3004,22 @@ class LLMDeployment:
             "ttft_s": req.first_token_at - req.submitted_at,
             "total_s": req.finished_at - req.submitted_at,
         }
+
+    def _handoff_record(self, req) -> dict:
+        """What a migrated request's consumer needs to resume it
+        elsewhere: the decode-pool deployment (prefill role only — a
+        drain migration resumes within the same deployment), the
+        page-set descriptor for adoption, and the memoized chunk-hash
+        chain so the destination never re-hashes the context."""
+        hand: dict = {}
+        if self._pool_role == "prefill":
+            hand["deployment"] = self._pool_peer
+        if req.kv_handoff is not None:
+            hand["kv"] = req.kv_handoff
+        if req.prefix_hashes and self.engine.prefill_chunk:
+            hand["prefix_hashes"] = [h.hex() for h in req.prefix_hashes]
+            hand["prefix_chunk"] = self.engine.prefill_chunk
+        return hand
 
     # --------------------------------------------------------- streaming
     # Cursor protocol (consumed by DeploymentHandle.stream and the HTTP
@@ -2481,6 +3046,11 @@ class LLMDeployment:
             # splices exactly (see LLMEngine.submit).
             generated_ids=request.get("generated_ids"),
             request_id=request.get("request_id"),
+            # Adoption hint + memoized hash chain from a donor's
+            # handoff/export (see LLMEngine.submit).
+            kv=request.get("kv"),
+            prefix_hashes=request.get("prefix_hashes"),
+            prefix_chunk=request.get("prefix_chunk", 0),
         )
         self._streams[req.request_id] = req
         return req.request_id
@@ -2501,10 +3071,15 @@ class LLMDeployment:
         done = req.done.is_set() and cursor + len(toks) >= len(req.out_ids)
         out = {"tokens": toks, "done": done}
         if req.migrated:
-            # Drain export: the reader drains the local tail, then
-            # resubmits `(prompt, tokens so far)` to a surviving replica
-            # — done=True here ends only THIS replica's leg of the stream.
+            # Drain export / pool handoff: the reader drains the local
+            # tail, then resubmits `(prompt, tokens so far)` — done=True
+            # here ends only THIS replica's leg of the stream. The
+            # handoff record routes the resubmit (decode-pool peer) and
+            # carries the page-set descriptor for adoption.
             out["migrated"] = True
+            hand = self._handoff_record(req)
+            if hand:
+                out["handoff"] = hand
         if req.error:
             out["error"] = req.error
         if done:
@@ -2526,6 +3101,12 @@ class LLMDeployment:
 
     def metrics(self) -> dict:
         return self.engine.metrics()
+
+    def page_accounting(self) -> dict:
+        """Engine page-accounting closure (chaos tests / triage).
+        Meaningful only when the engine is quiescent — the check walks
+        host-side tables the engine thread mutates."""
+        return self.engine.page_accounting()
 
     def drain(self, timeout_s: float) -> dict:
         """Replica drain (called by Replica.drain on controller
@@ -2565,4 +3146,12 @@ class LLMDeployment:
             max_tokens=request.get("max_tokens", 64),
             temperature=request.get("temperature", 0.0),
             eos_id=request.get("eos_id"),
+            # Continuation / handoff context (see generate): resumes a
+            # stream migrated off another replica, with the page-set
+            # descriptor driving adoption on this one.
+            generated_ids=request.get("generated_ids"),
+            kv=request.get("kv"),
+            request_id=request.get("request_id"),
+            prefix_hashes=request.get("prefix_hashes"),
+            prefix_chunk=request.get("prefix_chunk", 0),
         )

@@ -41,6 +41,7 @@ logger = logging.getLogger(__name__)
 _OVERRIDE_FIELDS = (
     "num_replicas", "max_concurrent_queries", "user_config",
     "autoscaling_config", "ray_actor_options", "route_prefix",
+    "pool_role",
 )
 _APPS_NS = "serve_apps"
 

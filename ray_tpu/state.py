@@ -20,9 +20,24 @@ logger = logging.getLogger(__name__)
 
 
 def _gcs_address() -> tuple[str, int]:
+    import os
+
     from ray_tpu import api
 
-    client = api._ensure_client()
+    client = api._client
+    if client is None and (os.environ.get("RAY_TPU_GCS_ADDRESS")
+                           and os.environ.get("RAY_TPU_RAYLET_ADDRESS")):
+        # Inside a cluster worker that hasn't touched the client API
+        # yet: lazy-ATTACH (cheap, reads the env addresses). This is
+        # distinct from the clusterless case below, where
+        # _ensure_client would silently BOOT a whole local cluster as
+        # a side effect of a state query — the auto-init footgun every
+        # client-adjacent constructor now gates against.
+        client = api._ensure_client()
+    if client is None:
+        raise RuntimeError(
+            "state queries need a running cluster — call "
+            "ray_tpu.init() (or attach with RAY_TPU_ADDRESS) first")
     return client.gcs_address
 
 
