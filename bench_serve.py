@@ -138,6 +138,20 @@ def main() -> None:
                     help="fused decode window: tokens per dispatch")
     ap.add_argument("--bf16", action="store_true",
                     help="serve bf16 weights (halves decode HBM traffic)")
+    ap.add_argument("--weight-dtype", default="bf16",
+                    choices=("bf16", "int8"),
+                    help="llm_weight_dtype: int8 = per-output-channel"
+                         " symmetric int8 matmul planes + fp32 scale"
+                         " vectors, dequant fused at the consuming einsum"
+                         " (gpt.weight_view); bf16 = storage as loaded"
+                         " (fp32 masters unless --bf16). Requires"
+                         " --kv-mode paged")
+    ap.add_argument("--kv-dtype", default="bf16",
+                    choices=("bf16", "int8"),
+                    help="llm_kv_dtype: int8 = int8 KV page planes +"
+                         " per-page scale planes riding the same page"
+                         " tables (models/paged_kv.py). Requires"
+                         " --kv-mode paged")
     ap.add_argument("--kv-mode", default="dense", choices=("dense", "paged"),
                     help="paged = block-paged KV pool (models/paged_kv.py);"
                          " slot count stops being bounded by max_len x B")
@@ -355,6 +369,10 @@ def main() -> None:
     if args.real_replicas and args.tp > 1:
         ap.error("--tp drives the in-process engine only (replica"
                  " processes size their own device mesh)")
+    if ("int8" in (args.weight_dtype, args.kv_dtype)
+            and args.kv_mode != "paged"):
+        ap.error("--weight-dtype/--kv-dtype int8 require --kv-mode paged"
+                 " (quantized serving targets the paged engine)")
     phases = None
     if args.ramp:
         try:
@@ -441,6 +459,30 @@ def main() -> None:
                           else gpt.init_params(cfg, jax.random.key(0)))
         if draft_params is not None:
             draft_params = _to_bf16(draft_params)
+    quant_fidelity = None
+    if args.weight_dtype == "int8":
+        # Quantization-fidelity preflight, committed with the row: the
+        # int8 arm's logit MAE and eval-loss delta vs the SAME master
+        # weights it serves, on a fixed batch — the JSON carries its own
+        # accuracy evidence next to its byte counts.
+        import jax
+        import jax.numpy as jnp
+
+        if params is None:
+            params = gpt.init_params(cfg, jax.random.key(0))
+        qp = gpt.quantize_params(params)
+        ev = np.random.default_rng(123).integers(
+            0, cfg.vocab_size, (4, 129))
+        toks = jnp.asarray(ev[:, :-1], jnp.int32)
+        tgts = jnp.asarray(ev[:, 1:], jnp.int32)
+        lg0 = gpt.forward(params, toks, cfg)
+        lg1 = gpt.forward(qp, toks, cfg)
+        quant_fidelity = {
+            "logit_mae": round(float(jnp.abs(lg0 - lg1).mean()), 6),
+            "eval_loss_delta": round(
+                float(gpt.loss_fn(qp, toks, tgts, cfg))
+                - float(gpt.loss_fn(params, toks, tgts, cfg)), 6),
+        }
     engine = LLMEngine(cfg, params, n_slots=args.n_slots,
                        max_len=args.max_len,
                        decode_block=args.decode_block,
@@ -454,7 +496,12 @@ def main() -> None:
                        spec_draft_params=draft_params,
                        # Always explicit: the tp=1 ablation arm must pin
                        # tp=1, not fall through to a stray RAY_TPU_LLM_TP.
-                       tp=args.tp)
+                       tp=args.tp,
+                       # Same discipline for the quantization ablation:
+                       # every arm pins its dtypes, never a stray
+                       # RAY_TPU_LLM_{WEIGHT,KV}_DTYPE.
+                       weight_dtype=args.weight_dtype,
+                       kv_dtype=args.kv_dtype)
     # Shared-prefix workload: a small pool of "system prompts" that a
     # fraction of every prompt is drawn from. Built up front so the
     # multiset is deterministic regardless of client scheduling.
@@ -647,6 +694,23 @@ def main() -> None:
         row["weight_bytes_per_device"] = _weight_bytes_per_device(
             engine.params, engine.tp)
         row["kv_bytes_per_device"] = engine._pool_shard_bytes()
+        # Quantization ablation: dtype-width-derived byte streams from
+        # the same rule-table walk (int8 planes count 1 B + their fp32
+        # scale vectors; scale PLANES of a quantized pool ride the
+        # per-token quotient). weight_bytes_per_pass is the WHOLE
+        # model's decode stream (tp=1 view — the quantization headline
+        # independent of sharding); kv_bytes_per_token divides the full
+        # pool footprint (scales included) by its token capacity.
+        row["llm_weight_dtype"] = engine.weight_dtype
+        row["llm_kv_dtype"] = engine.kv_dtype
+        row["weight_bytes_per_pass"] = _weight_bytes_per_device(
+            engine.params, 1)
+        pool_tokens = engine.cache["k"].shape[1] * engine.page_size
+        row["kv_bytes_per_token"] = round(sum(
+            int(a.size) * a.dtype.itemsize
+            for a in engine.cache.values()) / pool_tokens, 4)
+        if quant_fidelity is not None:
+            row.update(quant_fidelity)
     row["prefix_cache"] = bool(engine.prefix_cache is not None)
     if engine.prefix_cache is not None:
         # Warm-vs-cold TTFT split (client-observed AND engine-side): the

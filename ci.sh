@@ -48,6 +48,7 @@ case "$TIER" in
       tests/test_kv_objects.py        # KV page-set donate/adopt ladder
       tests/test_tp_decode.py         # tensor-parallel decode: tp=2 smoke
                                       # (self-skips if <2 XLA host devices)
+      tests/test_quant.py             # int8 weights + KV scale planes
       tests/test_tune.py              # Tune: schedulers/searchers
       tests/test_workflow.py          # Workflows: DAG + resume
       tests/test_ops_layer.py         # model ops numerics
@@ -73,7 +74,7 @@ esac
 for guarded in tests/test_tracing.py tests/test_paged_attention.py \
                tests/test_chunked_prefill.py tests/test_prefix_cache.py \
                tests/test_spec_decode.py tests/test_kv_objects.py \
-               tests/test_tp_decode.py \
+               tests/test_tp_decode.py tests/test_quant.py \
                tests/test_graftlint.py \
                tests/test_graftlint_v2.py tests/test_flight_recorder.py \
                tests/test_autoscale.py tests/test_router.py \

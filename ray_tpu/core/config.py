@@ -367,6 +367,23 @@ class Config:
     # XLA_FLAGS=--xla_force_host_platform_device_count=N forks virtual
     # host devices (TESTING.md). Env: RAY_TPU_LLM_TP=2.
     llm_tp: int = 1
+    # Quantized serving — weight stream (models/gpt.quantize_params):
+    # "bf16" (storage as loaded, the default) | "int8" (per-output-channel
+    # symmetric int8 matmul planes + fp32 scale vectors; dequant fuses at
+    # the consuming einsum via gpt.weight_view — the fp32 plane is never
+    # re-materialized in HBM; norms/embeddings/biases stay float).
+    # Requires kv_mode="paged"; alongside an incompatible engine the
+    # global knob soft-disables (explicit constructor args still raise,
+    # like llm_prefill_chunk). Env: RAY_TPU_LLM_WEIGHT_DTYPE=int8.
+    llm_weight_dtype: str = "bf16"
+    # Quantized serving — KV stream (models/paged_kv.init_paged_kv):
+    # "bf16" (pool planes in cfg.dtype, the default) | "int8" (int8 page
+    # planes + per-page scale planes [L, P+1] riding the same page
+    # tables; scales are frozen at each page's first write, so COW /
+    # donation / adoption / drain stay pure page-id plumbing with zero
+    # scheduler or refcount changes). Same gating + soft-off/strict
+    # split as llm_weight_dtype. Env: RAY_TPU_LLM_KV_DTYPE=int8.
+    llm_kv_dtype: str = "bf16"
     # KV page-set transfer (serve/kv_objects.py): completed prefills and
     # drain exports donate their written KV pages as refcounted,
     # chunk-chain-keyed page-set objects; an admitting engine ADOPTS

@@ -21,7 +21,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.models.gpt import _BLOCK_KEYS, GPTConfig, _layer_norm
+from ray_tpu.models.gpt import (GPTConfig, _layer_norm, stack_block_params,
+                                weight_view)
 
 
 def init_kv_cache(cfg: GPTConfig, n_slots: int, max_len: int):
@@ -44,9 +45,9 @@ def _rotary_pos(x: jax.Array, rotary_dim: int, pos: jax.Array) -> jax.Array:
 
 
 def _qkv(h, layer, cfg):
-    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
-    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
-    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = jnp.einsum("bsd,dhk->bshk", h, weight_view(layer, "wq", cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, weight_view(layer, "wk", cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, weight_view(layer, "wv", cfg.dtype))
     return q, k, v
 
 
@@ -59,9 +60,10 @@ def _mlp(x, layer, cfg, tp_axis=None):
     pre-psum would count it tp times)."""
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
     up = jax.nn.gelu(
-        jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+        jnp.einsum("bsd,df->bsf", h, weight_view(layer, "w_up", cfg.dtype))
         + layer["b_up"].astype(cfg.dtype))
-    down = jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(cfg.dtype))
+    down = jnp.einsum("bsf,fd->bsd", up,
+                      weight_view(layer, "w_down", cfg.dtype))
     if tp_axis is not None:
         down = jax.lax.psum(down, tp_axis)
     return x + (down + layer["b_down"].astype(cfg.dtype))
@@ -84,7 +86,7 @@ def prefill(cfg: GPTConfig, params, tokens, cache, slot, length):
     S = tokens.shape[1]
     x = params["wte"].astype(cfg.dtype)[tokens]  # [1, S, D]
     pos = jnp.arange(S)[None, :]  # [1, S]
-    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    stacked = stack_block_params(params)
     scale = 1.0 / math.sqrt(cfg.head_dim)
 
     def body(x, inputs):
@@ -100,7 +102,7 @@ def prefill(cfg: GPTConfig, params, tokens, cache, slot, length):
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum("bhst,bthk->bshk", probs, v)
         x = x + jnp.einsum("bshk,hkd->bsd", attn,
-                           layer["wo"].astype(cfg.dtype))
+                           weight_view(layer, "wo", cfg.dtype))
         x = _mlp(x, layer, cfg)
         # Write this layer's prompt K/V into the slot (padded tail included;
         # masked out at decode time by the length-bounded attention mask).
@@ -130,7 +132,7 @@ def prefill_batch(cfg: GPTConfig, params, tokens, cache, slots, lengths):
     N, S = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens]            # [N, S, D]
     pos = jnp.broadcast_to(jnp.arange(S)[None, :], (N, S))
-    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    stacked = stack_block_params(params)
     scale = 1.0 / math.sqrt(cfg.head_dim)
 
     def body(x, inputs):
@@ -146,7 +148,7 @@ def prefill_batch(cfg: GPTConfig, params, tokens, cache, slots, lengths):
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum("bhst,bthk->bshk", probs, v)
         x = x + jnp.einsum("bshk,hkd->bsd", attn,
-                           layer["wo"].astype(cfg.dtype))
+                           weight_view(layer, "wo", cfg.dtype))
         x = _mlp(x, layer, cfg)
         # Scatter each row's prompt K/V into its slot (distinct slots).
         k_cache_l = k_cache_l.at[slots, :S].set(k.astype(cfg.dtype))
@@ -170,7 +172,7 @@ def _decode_once(cfg: GPTConfig, params, tokens, cache, positions):
     T = cache["k"].shape[2]
     x = params["wte"].astype(cfg.dtype)[tokens][:, None, :]  # [B, 1, D]
     pos = positions[:, None]  # [B, 1]
-    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    stacked = stack_block_params(params)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     batch_idx = jnp.arange(B)
 
@@ -192,7 +194,7 @@ def _decode_once(cfg: GPTConfig, params, tokens, cache, positions):
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum("bht,bthk->bhk", probs, v_cache_l)
         x = x + jnp.einsum("bhk,hkd->bd", attn,
-                           layer["wo"].astype(cfg.dtype))[:, None, :]
+                           weight_view(layer, "wo", cfg.dtype))[:, None, :]
         x = _mlp(x, layer, cfg)
         return x, (k_cache_l, v_cache_l)
 

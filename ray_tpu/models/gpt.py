@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import re
 from functools import partial
 from typing import Any
 
@@ -193,6 +194,13 @@ def partition_rules() -> tuple:
         (r"^w_up$", PartitionSpec(None, None, TP)),
         (r"^b_up$", PartitionSpec(None, TP)),
         (r"^w_down$", PartitionSpec(None, TP, None)),
+        # int8 scale companions (quantize_params): same rank as their
+        # plane with the reduced axes kept at size 1, so a head-sharded
+        # plane's scales shard along with it. wo/w_down scales reduce
+        # over the tp'd axis itself — size 1 can't shard, replicate.
+        (r"^w[qkv]_scale$", PartitionSpec(None, None, TP, None)),
+        (r"^w_up_scale$", PartitionSpec(None, None, TP)),
+        (r"^(wo|w_down)_scale$", PartitionSpec()),
         # Replicated tail: embeddings, layer norms, residual-side biases,
         # and the LM head (explicit entries — match_partition_rules
         # treats an unmatched leaf as an error, not as replication).
@@ -215,6 +223,91 @@ def init_params(cfg: GPTConfig, rng: jax.Array) -> dict[str, jax.Array]:
         else:
             params[name] = jnp.zeros(shape, cfg.param_dtype)
     return params
+
+
+# --------------------------------------------------------------------------
+# int8 weight quantization (serving).
+#
+# Per-output-channel symmetric int8 for the matmul planes only — the
+# leaves whose HBM stream dominates weight-bound decode. Rule table is
+# keyed off the same `/`-joined pytree paths as partition_rules(), and
+# each rule names the CONTRACTION axes (reduced with keepdims), so a
+# quantized leaf `name` gains an fp32 `name_scale` companion of the same
+# rank whose surviving axes line up with the plane's — tp head-sharding
+# then shards the scales alongside their planes by construction.
+# Norms, embeddings, biases, and the LM head stay in param_dtype.
+
+QUANT_RULES: tuple = (
+    (r"^w[qkv]$", (1,)),      # [L, D, H, K]: reduce D  → scale [L, 1, H, K]
+    (r"^wo$", (1, 2)),        # [L, H, K, D]: reduce HK → scale [L, 1, 1, D]
+    (r"^w_up$", (1,)),        # [L, D, F]:    reduce D  → scale [L, 1, F]
+    (r"^w_down$", (1,)),      # [L, F, D]:    reduce F  → scale [L, 1, D]
+)
+
+
+def quant_axes(name: str):
+    """Contraction axes for a quantizable leaf path, else None."""
+    for pat, axes in QUANT_RULES:
+        if re.search(pat, name):
+            return axes
+    return None
+
+
+def quantize_params(params: dict[str, jax.Array]) -> dict[str, jax.Array]:
+    """Symmetric per-output-channel int8 quantization of the matmul
+    weights (QUANT_RULES). Idempotent: already-int8 leaves pass through
+    untouched with their existing scales, so a pre-quantized checkpoint
+    (or an engine-quantized draft handed back in) round-trips."""
+    out = dict(params)
+    for name, w in params.items():
+        axes = quant_axes(name)
+        if axes is None or name.endswith("_scale") or w.dtype == jnp.int8:
+            continue
+        w32 = w.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(w32), axis=axes, keepdims=True)
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        out[name] = jnp.clip(jnp.round(w32 / scale),
+                             -127, 127).astype(jnp.int8)
+        out[name + "_scale"] = scale
+    return out
+
+
+def dequant(plane: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """THE sanctioned int8→float dequant (graftlint QUANT-UPCAST allows
+    the upcast only here): elementwise and adjacent to the consuming
+    einsum, so XLA fuses it into the matmul read instead of
+    re-materializing a float plane in HBM."""
+    return plane.astype(dtype) * scale.astype(dtype)
+
+
+def weight_view(tree: dict[str, jax.Array], name: str, dtype) -> jax.Array:
+    """Compute-dtype view of weight `name`: fused dequant when the
+    stored plane is int8 (its `{name}_scale` companion must ride in the
+    same tree), plain cast otherwise. Every traced matmul consumption
+    site routes through here — never through a direct `.astype` on the
+    stored leaf."""
+    w = tree[name]
+    if w.dtype == jnp.int8:
+        return dequant(w, tree[name + "_scale"], dtype)
+    return w.astype(dtype)
+
+
+def stack_block_params(params: dict[str, jax.Array],
+                       dtype=None) -> dict[str, jax.Array]:
+    """Per-layer stacked leaf dict for scan bodies: `_BLOCK_KEYS` plus
+    the `*_scale` companions of any int8 plane (scan slices layer l of
+    a [L, 1, ...] scale to [1, ...], which broadcasts in dequant). With
+    `dtype`, float leaves are pre-cast once outside the scan (the paged
+    engine's convention); int8 planes always stay compressed."""
+    stacked = {}
+    for k in _BLOCK_KEYS:
+        w = params[k]
+        if w.dtype == jnp.int8:
+            stacked[k] = w
+            stacked[k + "_scale"] = params[k + "_scale"]
+        else:
+            stacked[k] = w if dtype is None else w.astype(dtype)
+    return stacked
 
 
 def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
@@ -278,19 +371,21 @@ def _block(
 ) -> jax.Array:
     """One pre-norm transformer block. x: [B, S, D]."""
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cfg.dtype))
-    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cfg.dtype))
-    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cfg.dtype))
+    q = jnp.einsum("bsd,dhk->bshk", h, weight_view(layer, "wq", cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, weight_view(layer, "wk", cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, weight_view(layer, "wv", cfg.dtype))
     q = _rotary(q, cfg.rotary_dim)
     k = _rotary(k, cfg.rotary_dim)
     attn = _attention(q, k, v, cfg, mesh=mesh)
-    attn_out = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(cfg.dtype))
+    attn_out = jnp.einsum("bshk,hkd->bsd", attn,
+                          weight_view(layer, "wo", cfg.dtype))
     x = x + attn_out
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
-    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(cfg.dtype))
+    up = jnp.einsum("bsd,df->bsf", h, weight_view(layer, "w_up", cfg.dtype))
     up = up + layer["b_up"].astype(cfg.dtype)
     up = jax.nn.gelu(up)
-    down = jnp.einsum("bsf,fd->bsd", up, layer["w_down"].astype(cfg.dtype))
+    down = jnp.einsum("bsf,fd->bsd", up,
+                      weight_view(layer, "w_down", cfg.dtype))
     down = down + layer["b_down"].astype(cfg.dtype)
     return x + down
 
@@ -313,7 +408,7 @@ def forward_hidden(
     ring-attention path runs in an explicit shard_map over it).
     """
     x = params["wte"].astype(cfg.dtype)[tokens]
-    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    stacked = stack_block_params(params)
     block_fn = lambda x, layer: _block(x, layer, cfg, mesh)
 
     def body(x, layer):
@@ -359,7 +454,7 @@ def forward_pipeline(
     from ray_tpu.parallel.pipeline import pipeline_apply
 
     x = params["wte"].astype(cfg.dtype)[tokens]
-    stacked = {k: params[k] for k in _BLOCK_KEYS}
+    stacked = stack_block_params(params)
 
     def stage(local_stack, act):
         def body(a, layer):

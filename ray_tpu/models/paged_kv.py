@@ -49,14 +49,107 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.models.gpt import GPTConfig, _layer_norm
-from ray_tpu.models.decode import _BLOCK_KEYS, _head, _mlp, _qkv, _rotary_pos
+from ray_tpu.models.gpt import (GPTConfig, _layer_norm, stack_block_params,
+                                weight_view)
+from ray_tpu.models.decode import _head, _mlp, _qkv, _rotary_pos
 
 
-def init_paged_kv(cfg: GPTConfig, n_pages: int, page_size: int):
-    """Shared page pool. Row 0 is the null page (never allocated)."""
+def init_paged_kv(cfg: GPTConfig, n_pages: int, page_size: int,
+                  kv_dtype: str | None = None):
+    """Shared page pool. Row 0 is the null page (never allocated).
+
+    ``kv_dtype`` None/"bf16" (default): K/V planes in cfg.dtype — the
+    original pool. "int8": int8 page planes plus one per-page scale
+    PLANE per side (``k_scale``/``v_scale`` [L, P+1], bf16) that rides
+    the same page-id axis as the data — so COW (`copy_pages`),
+    donation (`gather_pages`), adoption (`scatter_pages`), and
+    failover move scales with their pages through the existing
+    dict-generic page ops, with zero scheduler/refcount changes. Scales
+    are set at a page's FIRST write (any write at in-page offset 0
+    resets — offset 0 means the writer owns a fresh or recycled page)
+    and frozen until the page restarts; later tokens clip at the
+    frozen scale, so no already-written token is ever re-scaled."""
     shape = (cfg.n_layers, n_pages + 1, page_size, cfg.n_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+    if kv_dtype in (None, "bf16"):
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+    if kv_dtype != "int8":
+        raise ValueError(f"kv_dtype must be bf16|int8, got {kv_dtype!r}")
+    scale_shape = (cfg.n_layers, n_pages + 1)
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.bfloat16),
+            "v_scale": jnp.zeros(scale_shape, jnp.bfloat16)}
+
+
+def _quant_write(pool_l, scale_l, write_pages, write_offs, values,
+                 tp_axis=None):
+    """Quantized scatter of per-token K/V rows into one layer's int8
+    page plane, maintaining the per-page scale plane.
+
+    values: [M, ...] float rows landing at (write_pages[m],
+    write_offs[m]). Scale policy — frozen-at-first-write: a page's
+    scale is (re)set from this dispatch's scatter-max of |values| over
+    rows landing in it iff some row lands at offset 0 (a fresh/recycled
+    page — no earlier live content to invalidate) or the page has never
+    been scaled; otherwise the existing scale is kept and rows quantize
+    against it (clipped to ±127 — bounded saturation, never corruption
+    of already-written tokens). Null-page (id 0) writes perturb only
+    the null scale, which no masked read ever consumes. Under tensor
+    parallelism the contribution is pmax'd across head shards so the
+    replicated scale plane stays shard-identical."""
+    n_rows = scale_l.shape[0]
+    v32 = values.astype(jnp.float32)
+    vmax = jnp.max(jnp.abs(v32), axis=tuple(range(1, v32.ndim)))   # [M]
+    starts = jnp.zeros((n_rows,), jnp.int32).at[write_pages].max(
+        (write_offs == 0).astype(jnp.int32))
+    contrib = jnp.zeros((n_rows,), jnp.float32).at[write_pages].max(vmax)
+    if tp_axis is not None:
+        contrib = jax.lax.pmax(contrib, tp_axis)
+    old = scale_l.astype(jnp.float32)
+    new_scale = jnp.where((starts > 0) | (old <= 0.0),
+                          jnp.maximum(contrib, 1e-8) / 127.0, old)
+    s = new_scale[write_pages].reshape((-1,) + (1,) * (v32.ndim - 1))
+    q = jnp.clip(jnp.round(v32 / s), -127, 127).astype(jnp.int8)
+    return (pool_l.at[write_pages, write_offs].set(q),
+            new_scale.astype(scale_l.dtype))
+
+
+def _quant_write_full_pages(pool_l, scale_l, pages, values, tp_axis=None):
+    """Whole-page variant (one-shot paged prefill): values [M, ps, ...]
+    fills pages[m] end to end — by construction a first write, so every
+    target page's scale resets from its own payload. Duplicate ids only
+    ever name the null page (zero padding), where any write order gives
+    the same harmless result."""
+    v32 = values.astype(jnp.float32)
+    vmax = jnp.max(jnp.abs(v32), axis=tuple(range(1, v32.ndim)))   # [M]
+    if tp_axis is not None:
+        vmax = jax.lax.pmax(vmax, tp_axis)
+    new_scale = scale_l.astype(jnp.float32).at[pages].set(
+        jnp.maximum(vmax, 1e-8) / 127.0)
+    s = new_scale[pages].reshape((-1,) + (1,) * (v32.ndim - 1))
+    q = jnp.clip(jnp.round(v32 / s), -127, 127).astype(jnp.int8)
+    return (pool_l.at[pages].set(q), new_scale.astype(scale_l.dtype))
+
+
+def _pool_xs(stacked, pool, quant):
+    """Per-layer scan operands: block params + the pool planes (scale
+    planes ride along when the pool is quantized — scanning [L, P+1]
+    over L hands each layer its [P+1] scale vector)."""
+    if quant:
+        return (stacked, pool["k"], pool["v"],
+                pool["k_scale"], pool["v_scale"])
+    return (stacked, pool["k"], pool["v"])
+
+
+def _pool_of(carry, quant):
+    """Rebuild the pool dict from a scan's stacked carry outputs."""
+    if quant:
+        new_k, new_v, new_ks, new_vs = carry
+        return {"k": new_k, "v": new_v,
+                "k_scale": new_ks, "v_scale": new_vs}
+    new_k, new_v = carry
+    return {"k": new_k, "v": new_v}
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -88,16 +181,18 @@ def gather_pages(pool, pages):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
-def scatter_pages(pool, pages, k_data, v_data):
-    """Write page payloads ``(k_data, v_data)[:, i]`` into pool rows
+def scatter_pages(pool, pages, payload):
+    """Write page payloads ``payload[name][:, i]`` into pool rows
     ``pages[i]`` across every layer in ONE fused dispatch — the
-    adoption path of the KV page-set store. Padding convention mirrors
-    copy_pages: the caller pads ``pages`` with null-page (0) ids and
-    zero payloads; writes to the null page are harmless, and real
-    target ids are freshly allocated (never aliased), so scatter order
-    cannot matter."""
-    return {"k": pool["k"].at[:, pages].set(k_data),
-            "v": pool["v"].at[:, pages].set(v_data)}
+    adoption path of the KV page-set store. ``payload`` carries one
+    entry per pool plane (K/V data, plus the per-page scale planes of a
+    quantized pool — `gather_pages` emits exactly this dict), so
+    adopted pages land with the scales they were quantized under.
+    Padding convention mirrors copy_pages: the caller pads ``pages``
+    with null-page (0) ids and zero payloads; writes to the null page
+    are harmless, and real target ids are freshly allocated (never
+    aliased), so scatter order cannot matter."""
+    return {k: pool[k].at[:, pages].set(payload[k]) for k in pool}
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(3,))
@@ -113,16 +208,21 @@ def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
     ps = pool["k"].shape[2]
     n_pg = pages.shape[1]
     S_pad = n_pg * ps
+    quant = "k_scale" in pool
     x = params["wte"].astype(cfg.dtype)[tokens]            # [N, S, D]
     pos = jnp.broadcast_to(jnp.arange(S)[None, :], (N, S))
     # One up-front cast of the stacked block params (the per-layer
-    # `.astype(cfg.dtype)` calls inside the scan body become no-ops).
-    stacked = {k: params[k].astype(cfg.dtype) for k in _BLOCK_KEYS}
+    # weight_view casts inside the scan body become no-ops; int8 planes
+    # stay compressed and dequant fuses into their consuming einsums).
+    stacked = stack_block_params(params, cfg.dtype)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     flat_pages = pages.reshape(-1)                         # [N * n_pg]
 
     def body(x, inputs):
-        layer, k_pool_l, v_pool_l = inputs
+        if quant:
+            layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l = inputs
+        else:
+            layer, k_pool_l, v_pool_l = inputs
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
         q, k, v = _qkv(h, layer, cfg)
         q = _rotary_pos(q, cfg.rotary_dim, pos)
@@ -134,24 +234,29 @@ def prefill_batch_paged(cfg: GPTConfig, params, tokens, pool, pages, lengths):
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum("bhst,bthk->bshk", probs, v)
         x = x + jnp.einsum("bshk,hkd->bsd", attn,
-                           layer["wo"].astype(cfg.dtype))
+                           weight_view(layer, "wo", cfg.dtype))
         x = _mlp(x, layer, cfg)
 
         def paged(arr):                                    # [N,S,H,K] → pages
             a = jnp.pad(arr, ((0, 0), (0, S_pad - S), (0, 0), (0, 0)))
             return a.reshape(N * n_pg, ps, cfg.n_heads, cfg.head_dim)
 
+        if quant:
+            k_pool_l, k_sc_l = _quant_write_full_pages(
+                k_pool_l, k_sc_l, flat_pages, paged(k))
+            v_pool_l, v_sc_l = _quant_write_full_pages(
+                v_pool_l, v_sc_l, flat_pages, paged(v))
+            return x, (k_pool_l, v_pool_l, k_sc_l, v_sc_l)
         k_pool_l = k_pool_l.at[flat_pages].set(paged(k.astype(cfg.dtype)))
         v_pool_l = v_pool_l.at[flat_pages].set(paged(v.astype(cfg.dtype)))
         return x, (k_pool_l, v_pool_l)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (stacked, pool["k"], pool["v"]))
+    x, carry = jax.lax.scan(body, x, _pool_xs(stacked, pool, quant))
     logits = _head(params, cfg, x)                         # [N, S, V]
     last = jnp.take_along_axis(
         logits, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]
-    return last, {"k": new_k, "v": new_v}
+    return last, _pool_of(carry, quant)
 
 
 def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
@@ -170,10 +275,11 @@ def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
     → (hidden states [N, C, D], updated pool)."""
     N, C = tokens.shape
     ps = pool["k"].shape[2]
+    quant = "k_scale" in pool
     x = params["wte"].astype(cfg.dtype)[tokens]            # [N, C, D]
     rel = jnp.arange(C)
     pos = offsets[:, None] + rel[None, :]                  # [N, C]
-    stacked = {k: params[k].astype(cfg.dtype) for k in _BLOCK_KEYS}
+    stacked = stack_block_params(params, cfg.dtype)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     # Write targets: pad/inert positions (rel >= n_valid) scatter to the
     # null page — harmless, read-masked. The page index is clamped
@@ -187,7 +293,11 @@ def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
     kv_lens = offsets + n_valid                                 # [N]
 
     def body(x, inputs):
-        layer, k_pool_l, v_pool_l = inputs
+        if quant:
+            layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l = inputs
+        else:
+            layer, k_pool_l, v_pool_l = inputs
+            k_sc_l = v_sc_l = None
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
         q, k, v = _qkv(h, layer, cfg)
         q = _rotary_pos(q, cfg.rotary_dim, pos)
@@ -197,34 +307,43 @@ def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
         # intra-chunk causality is just the tpos <= qpos mask.
         # Head count from the array, not the config: under tensor
         # parallelism this body sees the per-shard head slice.
-        k_pool_l = k_pool_l.at[write_pages, write_offs].set(
-            k.reshape(N * C, *k.shape[2:]).astype(cfg.dtype))
-        v_pool_l = v_pool_l.at[write_pages, write_offs].set(
-            v.reshape(N * C, *v.shape[2:]).astype(cfg.dtype))
+        if quant:
+            k_pool_l, k_sc_l = _quant_write(
+                k_pool_l, k_sc_l, write_pages, write_offs,
+                k.reshape(N * C, *k.shape[2:]), tp_axis)
+            v_pool_l, v_sc_l = _quant_write(
+                v_pool_l, v_sc_l, write_pages, write_offs,
+                v.reshape(N * C, *v.shape[2:]), tp_axis)
+        else:
+            k_pool_l = k_pool_l.at[write_pages, write_offs].set(
+                k.reshape(N * C, *k.shape[2:]).astype(cfg.dtype))
+            v_pool_l = v_pool_l.at[write_pages, write_offs].set(
+                v.reshape(N * C, *v.shape[2:]).astype(cfg.dtype))
         if attn_impl == "kernel":
             from ray_tpu.ops.paged_attention import paged_prefill_attention
 
             attn = paged_prefill_attention(
                 q, k_pool_l, v_pool_l, tables, offsets, kv_lens,
-                sm_scale=scale)
+                sm_scale=scale, k_scale=k_sc_l, v_scale=v_sc_l)
         else:
             from ray_tpu.ops.paged_attention import (
                 reference_paged_prefill_attention)
 
             attn = reference_paged_prefill_attention(
                 q, k_pool_l, v_pool_l, tables, offsets, kv_lens,
-                sm_scale=scale)
+                sm_scale=scale, k_scale=k_sc_l, v_scale=v_sc_l)
         attn_out = jnp.einsum("bchk,hkd->bcd", attn,
-                              layer["wo"].astype(cfg.dtype))
+                              weight_view(layer, "wo", cfg.dtype))
         if tp_axis is not None:
             attn_out = jax.lax.psum(attn_out, tp_axis)
         x = x + attn_out
         x = _mlp(x, layer, cfg, tp_axis=tp_axis)
+        if quant:
+            return x, (k_pool_l, v_pool_l, k_sc_l, v_sc_l)
         return x, (k_pool_l, v_pool_l)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (stacked, pool["k"], pool["v"]))
-    return x, {"k": new_k, "v": new_v}
+    x, carry = jax.lax.scan(body, x, _pool_xs(stacked, pool, quant))
+    return x, _pool_of(carry, quant)
 
 
 @functools.partial(jax.jit, static_argnums=(0,),
@@ -330,12 +449,14 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
         raise ValueError(
             f"attn_impl must be gather|kernel, got {attn_impl!r}")
     ps = pool["k"].shape[2]
+    quant = "k_scale" in pool
     x = params["wte"].astype(cfg.dtype)[tokens][:, None, :]  # [B, 1, D]
     pos = positions[:, None]
-    # Pre-cast the stacked block params once: the per-layer
-    # `layer[...].astype(cfg.dtype)` calls inside the scan body become
-    # no-ops instead of re-lowering a convert per layer per step.
-    stacked = {k: params[k].astype(cfg.dtype) for k in _BLOCK_KEYS}
+    # Pre-cast the stacked block params once: the per-layer weight_view
+    # casts inside the scan body become no-ops instead of re-lowering a
+    # convert per layer per step (int8 planes stay compressed — their
+    # dequant fuses into the consuming einsum).
+    stacked = stack_block_params(params, cfg.dtype)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     # Write target + kv length are loop-invariant across layers — computed
     # once here, never inside the scan body. The page index is clamped
@@ -350,15 +471,25 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
     kv_lengths = positions + 1                               # [B]
 
     def body(x, inputs):
-        layer, k_pool_l, v_pool_l = inputs
+        if quant:
+            layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l = inputs
+        else:
+            layer, k_pool_l, v_pool_l = inputs
+            k_sc_l = v_sc_l = None
         h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
         q, k, v = _qkv(h, layer, cfg)
         q = _rotary_pos(q, cfg.rotary_dim, pos)
         k = _rotary_pos(k, cfg.rotary_dim, pos)
-        k_pool_l = k_pool_l.at[write_page, write_off].set(
-            k[:, 0].astype(cfg.dtype))
-        v_pool_l = v_pool_l.at[write_page, write_off].set(
-            v[:, 0].astype(cfg.dtype))
+        if quant:
+            k_pool_l, k_sc_l = _quant_write(
+                k_pool_l, k_sc_l, write_page, write_off, k[:, 0], tp_axis)
+            v_pool_l, v_sc_l = _quant_write(
+                v_pool_l, v_sc_l, write_page, write_off, v[:, 0], tp_axis)
+        else:
+            k_pool_l = k_pool_l.at[write_page, write_off].set(
+                k[:, 0].astype(cfg.dtype))
+            v_pool_l = v_pool_l.at[write_page, write_off].set(
+                v[:, 0].astype(cfg.dtype))
         if attn_impl == "kernel":
             # Ragged paged attention: K/V pages are read in place from
             # the pool (one DMA per live page, pl.when-skipped null
@@ -366,7 +497,8 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
             from ray_tpu.ops.paged_attention import paged_attention
 
             attn = paged_attention(q[:, 0], k_pool_l, v_pool_l, tables,
-                                   kv_lengths, sm_scale=scale)
+                                   kv_lengths, sm_scale=scale,
+                                   k_scale=k_sc_l, v_scale=v_sc_l)
         else:
             # Gather reference: reconstitute the contiguous [B, T, H, K]
             # timeline — ONE implementation shared with the kernel's test
@@ -376,19 +508,20 @@ def _decode_once_paged(cfg: GPTConfig, params, tokens, pool, positions,
 
             attn = reference_paged_attention(
                 q[:, 0], k_pool_l, v_pool_l, tables, kv_lengths,
-                sm_scale=scale)
+                sm_scale=scale, k_scale=k_sc_l, v_scale=v_sc_l)
         attn_out = jnp.einsum("bhk,hkd->bd", attn,
-                              layer["wo"].astype(cfg.dtype))
+                              weight_view(layer, "wo", cfg.dtype))
         if tp_axis is not None:
             attn_out = jax.lax.psum(attn_out, tp_axis)
         x = x + attn_out[:, None, :]
         x = _mlp(x, layer, cfg, tp_axis=tp_axis)
+        if quant:
+            return x, (k_pool_l, v_pool_l, k_sc_l, v_sc_l)
         return x, (k_pool_l, v_pool_l)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (stacked, pool["k"], pool["v"]))
+    x, carry = jax.lax.scan(body, x, _pool_xs(stacked, pool, quant))
     logits = _head(params, cfg, x)[:, 0]
-    return logits, {"k": new_k, "v": new_v}
+    return logits, _pool_of(carry, quant)
 
 
 def _sample_next(logits, temps, key):
@@ -557,8 +690,12 @@ def _kv_pool_partition_rules():
 
     from ray_tpu.models.partition import TP_AXIS
 
+    # Scale planes [L, P+1] are REPLICATED: one per-page scalar covers
+    # every head, and _quant_write pmax's the scale contribution across
+    # head shards, so each shard's copy stays identical by construction.
     return ((r"^(k|v)$",
-             PartitionSpec(None, None, None, TP_AXIS, None)),)
+             PartitionSpec(None, None, None, TP_AXIS, None)),
+            (r"^(k|v)_scale$", PartitionSpec()))
 
 
 KV_POOL_PARTITION_RULES = _kv_pool_partition_rules()

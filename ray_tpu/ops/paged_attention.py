@@ -53,12 +53,23 @@ def _interpret_default() -> bool:
 
 
 def _decode_kernel(
-    tables_ref, lengths_ref,            # scalar-prefetch (SMEM)
-    q_ref, k_ref, v_ref,                # VMEM blocks
-    o_ref,
-    m_ref, l_ref, acc_ref,              # VMEM scratch
-    *, sm_scale, page_size, n_pg,
+    *refs,
+    sm_scale, page_size, n_pg, quantized=False,
 ):
+    # Ref order: scalar-prefetch (SMEM) first — page tables, kv lengths,
+    # and (quantized pools only) the layer's per-page K/V scale vectors —
+    # then VMEM blocks (q, k, v), the output, and the (m, l, acc)
+    # scratch. `quantized` is a Python-level trace switch: the bf16
+    # program is untouched and the int8 program dequants each page right
+    # after its DMA, inside the kernel — the fp32 plane never exists in
+    # HBM.
+    if quantized:
+        (tables_ref, lengths_ref, ks_ref, vs_ref,
+         q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -74,6 +85,10 @@ def _decode_kernel(
         q = q_ref[0]                         # [H, K]
         k = k_ref[0]                         # [ps, H, K]
         v = v_ref[0]
+        if quantized:
+            page = tables_ref[b, j]
+            k = k.astype(jnp.float32) * ks_ref[page]
+            v = v.astype(jnp.float32) * vs_ref[page]
         # s[h, t] = q[h] · k[t, h] — a per-head batched matvec; decode
         # attention is HBM-bound (~2 flops/byte), so MXU shape efficiency
         # is irrelevant next to reading the page once.
@@ -118,13 +133,19 @@ def paged_attention(
     *,
     sm_scale: float | None = None,
     interpret: bool | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Single-token decode attention straight against the KV page pool.
 
     Args:
       q: [B, H, K] — each slot's current-token query (post-rotary).
       k_pool, v_pool: [P, page_size, H, K] — ONE layer's page pool (row 0
-        is the reserved null page).
+        is the reserved null page). May be int8 (quantized serving), in
+        which case ``k_scale``/``v_scale`` must carry the layer's
+        per-page scale vectors [P] — they ride the scalar-prefetch path
+        next to the page table, and each page is dequanted in VMEM right
+        after its DMA (the fp32 plane never exists in HBM).
       tables: [B, n_pg] int32 page ids per slot (unallocated tail = 0).
       lengths: [B] int32 valid kv positions per slot (= position + 1; the
         current token's K/V must already be written to its page).
@@ -145,20 +166,29 @@ def paged_attention(
         interpret = _interpret_default()
     tables = tables.astype(jnp.int32)
     lengths = lengths.astype(jnp.int32)
+    quantized = k_scale is not None
 
     kernel = functools.partial(
-        _decode_kernel, sm_scale=sm_scale, page_size=ps, n_pg=n_pg)
+        _decode_kernel, sm_scale=sm_scale, page_size=ps, n_pg=n_pg,
+        quantized=quantized)
+    if quantized:
+        prefetch = (tables, lengths, k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32))
+        im_q = lambda b, j, tbl, lens, ks, vs: (b, 0, 0)
+        im_kv = lambda b, j, tbl, lens, ks, vs: (tbl[b, j], 0, 0, 0)
+    else:
+        prefetch = (tables, lengths)
+        im_q = lambda b, j, tbl, lens: (b, 0, 0)
+        im_kv = lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=len(prefetch),
         grid=(B, n_pg),
         in_specs=[
-            pl.BlockSpec((1, H, K), lambda b, j, tbl, lens: (b, 0, 0)),
-            pl.BlockSpec((1, ps, H, K),
-                         lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
-            pl.BlockSpec((1, ps, H, K),
-                         lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, H, K), im_q),
+            pl.BlockSpec((1, ps, H, K), im_kv),
+            pl.BlockSpec((1, ps, H, K), im_kv),
         ],
-        out_specs=pl.BlockSpec((1, H, K), lambda b, j, tbl, lens: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, K), im_q),
         scratch_shapes=[
             pltpu.VMEM((H, _LANES), jnp.float32),
             pltpu.VMEM((H, _LANES), jnp.float32),
@@ -170,15 +200,12 @@ def paged_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, K), q.dtype),
         interpret=interpret,
-    )(tables, lengths, q, k_pool, v_pool)
+    )(*prefetch, q, k_pool, v_pool)
 
 
 def _prefill_kernel(
-    tables_ref, offsets_ref, lengths_ref,   # scalar-prefetch (SMEM)
-    q_ref, k_ref, v_ref,                    # VMEM blocks
-    o_ref,
-    m_ref, l_ref, acc_ref,                  # VMEM scratch
-    *, sm_scale, page_size, n_pg,
+    *refs,
+    sm_scale, page_size, n_pg, quantized=False,
 ):
     """Ragged chunked-prefill attention: one query BLOCK (a prompt chunk at
     an arbitrary token offset) against the slot's page pool. The decode
@@ -187,7 +214,17 @@ def _prefill_kernel(
     acc) VMEM state across the kv-page grid axis — plus the causal mask
     INSIDE the chunk (tpos <= query's absolute position), which is what
     lets the chunk's own K/V be written to the pool before the kernel runs
-    and then read back like any earlier page."""
+    and then read back like any earlier page. Ref order mirrors
+    `_decode_kernel`: scalar-prefetch (tables, offsets, lengths, and for
+    int8 pools the per-page K/V scale vectors) first, then VMEM blocks;
+    `quantized` dequants each page in VMEM right after its DMA."""
+    if quantized:
+        (tables_ref, offsets_ref, lengths_ref, ks_ref, vs_ref,
+         q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        (tables_ref, offsets_ref, lengths_ref, q_ref, k_ref, v_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -204,6 +241,10 @@ def _prefill_kernel(
         q = q_ref[0]                         # [C, H, K]
         k = k_ref[0]                         # [ps, H, K]
         v = v_ref[0]
+        if quantized:
+            page = tables_ref[b, j]
+            k = k.astype(jnp.float32) * ks_ref[page]
+            v = v.astype(jnp.float32) * vs_ref[page]
         s = jnp.einsum("chk,thk->cht", q, k,
                        preferred_element_type=jnp.float32) * sm_scale
         # Causal within the whole sequence: query row c sits at absolute
@@ -249,6 +290,8 @@ def paged_prefill_attention(
     *,
     sm_scale: float | None = None,
     interpret: bool | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Chunked-prefill attention straight against the KV page pool.
 
@@ -256,9 +299,9 @@ def paged_prefill_attention(
       q: [B, C, H, K] — each slot's chunk of C queries (post-rotary),
         starting at absolute position ``offsets[b]``.
       k_pool, v_pool: [P, page_size, H, K] — ONE layer's page pool (row 0
-        is the reserved null page). The chunk's own K/V must already be
-        written to its pages (models/paged_kv.py writes before attending,
-        exactly like the decode path).
+        is the reserved null page). May be int8 (quantized serving) with
+        ``k_scale``/``v_scale`` [P] per-page scale vectors, handled
+        exactly as in `paged_attention`.
       tables: [B, n_pg] int32 page ids per slot (unallocated tail = 0).
       offsets: [B] int32 absolute position of q[:, 0].
       lengths: [B] int32 valid kv positions per slot (= offset + valid
@@ -279,22 +322,29 @@ def paged_prefill_attention(
     tables = tables.astype(jnp.int32)
     offsets = offsets.astype(jnp.int32)
     lengths = lengths.astype(jnp.int32)
+    quantized = k_scale is not None
 
     kernel = functools.partial(
-        _prefill_kernel, sm_scale=sm_scale, page_size=ps, n_pg=n_pg)
+        _prefill_kernel, sm_scale=sm_scale, page_size=ps, n_pg=n_pg,
+        quantized=quantized)
+    if quantized:
+        prefetch = (tables, offsets, lengths, k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32))
+        im_q = lambda b, j, tbl, offs, lens, ks, vs: (b, 0, 0, 0)
+        im_kv = lambda b, j, tbl, offs, lens, ks, vs: (tbl[b, j], 0, 0, 0)
+    else:
+        prefetch = (tables, offsets, lengths)
+        im_q = lambda b, j, tbl, offs, lens: (b, 0, 0, 0)
+        im_kv = lambda b, j, tbl, offs, lens: (tbl[b, j], 0, 0, 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=len(prefetch),
         grid=(B, n_pg),
         in_specs=[
-            pl.BlockSpec((1, C, H, K),
-                         lambda b, j, tbl, offs, lens: (b, 0, 0, 0)),
-            pl.BlockSpec((1, ps, H, K),
-                         lambda b, j, tbl, offs, lens: (tbl[b, j], 0, 0, 0)),
-            pl.BlockSpec((1, ps, H, K),
-                         lambda b, j, tbl, offs, lens: (tbl[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, C, H, K), im_q),
+            pl.BlockSpec((1, ps, H, K), im_kv),
+            pl.BlockSpec((1, ps, H, K), im_kv),
         ],
-        out_specs=pl.BlockSpec(
-            (1, C, H, K), lambda b, j, tbl, offs, lens: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, C, H, K), im_q),
         scratch_shapes=[
             pltpu.VMEM((C, H, _LANES), jnp.float32),
             pltpu.VMEM((C, H, _LANES), jnp.float32),
@@ -306,7 +356,7 @@ def paged_prefill_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, C, H, K), q.dtype),
         interpret=interpret,
-    )(tables, offsets, lengths, q, k_pool, v_pool)
+    )(*prefetch, q, k_pool, v_pool)
 
 
 # Speculative-verify reuse: the verify pass of draft-model speculative
@@ -321,27 +371,40 @@ def paged_prefill_attention(
 # verify_chunk_paged documents why the garbage K/V they leave is inert).
 
 def reference_paged_attention(q, k_pool, v_pool, tables, lengths, *,
-                              sm_scale=None):
+                              sm_scale=None, k_scale=None, v_scale=None):
     """Gather-semantics oracle: reconstitute each slot's contiguous
     timeline and run plain-XLA attention — byte-for-byte the math of
-    models/paged_kv.py's gather read path (test oracle + fallback)."""
+    models/paged_kv.py's gather read path (test oracle + fallback).
+
+    int8 pools pass per-page ``k_scale``/``v_scale`` [P]; the dequant
+    (page.astype(f32) * scale) mirrors the fused kernel exactly."""
     B, H, K = q.shape
     ps = k_pool.shape[1]
     T = tables.shape[1] * ps
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(K)
-    k_view = k_pool[tables].reshape(B, T, H, K)
-    v_view = v_pool[tables].reshape(B, T, H, K)
+    k_view = k_pool[tables]                      # [B, n_pg, ps, H, K]
+    v_view = v_pool[tables]
+    if k_scale is not None:
+        k_view = (k_view.astype(jnp.float32)
+                  * k_scale[tables][:, :, None, None, None].astype(jnp.float32))
+        v_view = (v_view.astype(jnp.float32)
+                  * v_scale[tables][:, :, None, None, None].astype(jnp.float32))
+    k_view = k_view.reshape(B, T, H, K)
+    v_view = v_view.reshape(B, T, H, K)
     s = jnp.einsum("bhk,bthk->bht", q, k_view,
                    preferred_element_type=jnp.float32) * sm_scale
     mask = jnp.arange(T)[None, :] < lengths[:, None]        # [B, T]
     s = jnp.where(mask[:, None, :], s, NEG_INF)
     probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bht,bthk->bhk", probs, v_view)
+    # q.dtype out unconditionally: the dequanted v_view is f32, and the
+    # einsum's promotion must not leak into callers' scan carries.
+    return jnp.einsum("bht,bthk->bhk", probs, v_view).astype(q.dtype)
 
 
 def reference_paged_prefill_attention(q, k_pool, v_pool, tables, offsets,
-                                      lengths, *, sm_scale=None):
+                                      lengths, *, sm_scale=None,
+                                      k_scale=None, v_scale=None):
     """Gather-semantics oracle for chunked prefill: reconstitute each
     slot's contiguous timeline from the pool and run plain-XLA causal
     attention for a C-query chunk at absolute offset — byte-for-byte the
@@ -355,8 +418,15 @@ def reference_paged_prefill_attention(q, k_pool, v_pool, tables, offsets,
     T = tables.shape[1] * ps
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(K)
-    k_view = k_pool[tables].reshape(B, T, H, K)
-    v_view = v_pool[tables].reshape(B, T, H, K)
+    k_view = k_pool[tables]                      # [B, n_pg, ps, H, K]
+    v_view = v_pool[tables]
+    if k_scale is not None:
+        k_view = (k_view.astype(jnp.float32)
+                  * k_scale[tables][:, :, None, None, None].astype(jnp.float32))
+        v_view = (v_view.astype(jnp.float32)
+                  * v_scale[tables][:, :, None, None, None].astype(jnp.float32))
+    k_view = k_view.reshape(B, T, H, K)
+    v_view = v_view.reshape(B, T, H, K)
     s = jnp.einsum("bchk,bthk->bhct", q, k_view,
                    preferred_element_type=jnp.float32) * sm_scale
     tpos = jnp.arange(T)                                    # [T]
@@ -365,7 +435,8 @@ def reference_paged_prefill_attention(q, k_pool, v_pool, tables, offsets,
             & (tpos[None, None, :] < lengths[:, None, None]))  # [B, C, T]
     s = jnp.where(mask[:, None], s, NEG_INF)
     probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhct,bthk->bchk", probs, v_view)
+    # q.dtype out unconditionally (see reference_paged_attention).
+    return jnp.einsum("bhct,bthk->bchk", probs, v_view).astype(q.dtype)
 
 
 __all__ = [

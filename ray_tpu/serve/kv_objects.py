@@ -96,18 +96,23 @@ def page_span(depth: int, chunk: int, page_size: int) -> tuple[int, int]:
 
 
 def engine_fingerprint(cfg, page_size: int, chunk: int,
-                       draft_cfg=None) -> str:
+                       draft_cfg=None, kv_dtype: str = "bf16") -> str:
     """Compatibility fingerprint: adopted page payloads are raw K/V
     planes, so donor and adopter must agree on model geometry, dtype,
     page size, AND chunk granularity (the key schedule). The draft
     geometry rides along when speculative decoding is on — the draft
-    pool mirrors target pages, so adoption must fill both."""
+    pool mirrors target pages, so adoption must fill both. A quantized
+    pool (int8 planes + per-page scales) appends its kv_dtype: its
+    payloads carry an extra plane set a bf16 adopter has no slot for,
+    and vice versa."""
     fp = (f"{cfg.n_layers}x{cfg.n_heads}x{cfg.head_dim}"
           f":{cfg.dtype.__name__ if hasattr(cfg.dtype, '__name__') else cfg.dtype}"
           f":ps{page_size}:c{chunk}")
     if draft_cfg is not None:
         fp += (f":d{draft_cfg.n_layers}x{draft_cfg.n_heads}"
                f"x{draft_cfg.head_dim}")
+    if kv_dtype and kv_dtype != "bf16":
+        fp += f":q{kv_dtype}"
     return fp
 
 

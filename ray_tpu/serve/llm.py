@@ -353,7 +353,9 @@ class LLMEngine:
                  spec_draft=None, spec_k: int | None = None,
                  spec_draft_params=None, tp: int | None = None,
                  pool_role: str | None = None,
-                 kv_transfer: bool | None = None, kv_store=None):
+                 kv_transfer: bool | None = None, kv_store=None,
+                 weight_dtype: str | None = None,
+                 kv_dtype: str | None = None):
         import types
 
         import jax
@@ -415,11 +417,14 @@ class LLMEngine:
         spec_explicit = spec_draft is not None
         tp_explicit = tp is not None
         kv_explicit = kv_transfer is not None
+        wdtype_explicit = weight_dtype is not None
+        kvdtype_explicit = kv_dtype is not None
         if (kv_mode is None or page_size is None or attn_impl is None
                 or prefill_chunk is None or prefill_token_budget is None
                 or prefix_cache is None or prefix_cache_pages is None
                 or spec_draft is None or spec_k is None or tp is None
-                or kv_transfer is None):
+                or kv_transfer is None or weight_dtype is None
+                or kv_dtype is None):
             from ray_tpu.core.config import runtime_config
 
             _rc = runtime_config()
@@ -444,6 +449,9 @@ class LLMEngine:
             tp = _rc.llm_tp if tp is None else tp
             kv_transfer = (_rc.llm_kv_transfer if kv_transfer is None
                            else kv_transfer)
+            weight_dtype = (_rc.llm_weight_dtype if weight_dtype is None
+                            else weight_dtype)
+            kv_dtype = _rc.llm_kv_dtype if kv_dtype is None else kv_dtype
         if prefill_chunk and kv_mode != "paged" and not chunk_explicit:
             # The global llm_prefill_chunk knob applies to paged engines;
             # a dense engine alongside it just keeps one-shot admission
@@ -467,6 +475,34 @@ class LLMEngine:
         if attn_impl not in ("gather", "kernel"):
             raise ValueError(
                 f"attn_impl must be gather|kernel, got {attn_impl!r}")
+        # Quantized serving (config-validation pattern from
+        # llm_prefill_chunk): the int8 weight/KV streams ride the paged
+        # engine only — dense mode keeps whole-tensor caches with no
+        # page planes to carry scales. GLOBAL dtype knobs alongside a
+        # dense engine soft-disable to "bf16" (a fleet-wide export must
+        # not crash replica boot); explicit args raise typed errors.
+        if weight_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"weight_dtype must be bf16|int8, got {weight_dtype!r}")
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be bf16|int8, got {kv_dtype!r}")
+        if weight_dtype == "int8" and kv_mode != "paged":
+            if wdtype_explicit:
+                raise ValueError(
+                    "weight_dtype='int8' requires kv_mode='paged' "
+                    "(quantized serving targets the paged engine; the "
+                    f"dense path is unquantized); got kv_mode={kv_mode!r}")
+            weight_dtype = "bf16"
+        if kv_dtype == "int8" and kv_mode != "paged":
+            if kvdtype_explicit:
+                raise ValueError(
+                    "kv_dtype='int8' requires kv_mode='paged' (the scale "
+                    "planes ride the page tables; the dense cache has "
+                    f"none); got kv_mode={kv_mode!r}")
+            kv_dtype = "bf16"
+        self.weight_dtype = weight_dtype
+        self.kv_dtype = kv_dtype
         if prefill_chunk < 0 or (prefill_chunk and kv_mode != "paged"):
             raise ValueError(
                 "prefill_chunk requires kv_mode='paged' (chunked prefill "
@@ -653,7 +689,8 @@ class LLMEngine:
                 n_pages = max(self.max_pages_per_slot + 1,
                               (n_slots * self.max_pages_per_slot) // 2)
             self.n_pages = n_pages
-            self.cache = init_paged_kv(cfg, n_pages, page_size)
+            self.cache = init_paged_kv(cfg, n_pages, page_size,
+                                       kv_dtype=self.kv_dtype)
             self.page_table = np.zeros(
                 (n_slots, self.max_pages_per_slot), np.int32)
             self.slot_n_pages = np.zeros(n_slots, np.int64)
@@ -691,11 +728,23 @@ class LLMEngine:
                 spec_draft_params if spec_draft_params is not None
                 else gpt.init_params(draft_cfg, jax.random.key(seed + 1)))
             self.draft_cache = init_paged_kv(
-                draft_cfg, self.n_pages, self.page_size)
+                draft_cfg, self.n_pages, self.page_size,
+                kv_dtype=self.kv_dtype)
             # Acceptance draws (temperature>0 rejection sampling) come
             # from a host-side generator: they gate host control flow
             # (emit / rollback), so deviceifying them buys nothing.
             self._spec_rng = np.random.default_rng(seed)
+        if self.weight_dtype == "int8":
+            # One-time compression at load: matmul planes become int8 +
+            # per-output-channel fp32 scale vectors (gpt.QUANT_RULES).
+            # Idempotent, so pre-quantized checkpoints (or an int8
+            # spec_draft_params next to a bf16 target) pass through.
+            # BEFORE the tp shard below: the scale rules in
+            # gpt.partition_rules shard the new leaves alongside their
+            # planes, so quantize-then-shard is the only order.
+            self.params = gpt.quantize_params(self.params)
+            if spec_draft:
+                self.draft_params = gpt.quantize_params(self.draft_params)
         if self.tp > 1:
             # Shard ONCE at load onto the mesh validation built: params
             # (target + draft) per gpt.partition_rules, page pools along
@@ -785,7 +834,8 @@ class LLMEngine:
                               else _kvo.get_store(donor=self._kv_donor))
             self._kv_fingerprint = _kvo.engine_fingerprint(
                 cfg, page_size, prefill_chunk,
-                draft_cfg if spec_draft else None)
+                draft_cfg if spec_draft else None,
+                kv_dtype=self.kv_dtype)
         # slot -> pinned CacheEntry while the slot is live (released on
         # free/preempt), and the tick's pending COW (src, dst) pairs,
         # flushed in one fused device copy per tick (_apply_cow).
@@ -1257,6 +1307,16 @@ class LLMEngine:
                 m["kv_pages_free_min"] = self._min_free_pages
                 m["kv_page_size"] = self.page_size
                 m["llm_attn_impl"] = self.attn_impl
+                # Quantized-serving observability (rides the PR 6 chain:
+                # replica stats → serve.status() → /api/serve/load →
+                # `ray_tpu status --serve`): the dtype knobs as resolved
+                # (soft-off shows "bf16") + the pool's actual device
+                # bytes, scale planes included.
+                m["llm_weight_dtype"] = self.weight_dtype
+                m["llm_kv_dtype"] = self.kv_dtype
+                m["kv_pool_bytes"] = sum(
+                    int(math.prod(a.shape) * a.dtype.itemsize)
+                    for a in self.cache.values())
             m["llm_tp"] = self.tp
             if self.tp > 1:
                 m["mesh_shape"] = {"tp": self.tp}
@@ -1365,6 +1425,13 @@ class LLMEngine:
                 snap["pool_pages_free_min"] = self._min_free_pages
                 snap["pool_utilization"] = round(
                     1.0 - len(self.free_pages) / self.n_pages, 4)
+                # Quantized-serving load surface (PR 6 chain: replica
+                # stats → serve.status() → /api/serve/load → CLI).
+                snap["llm_weight_dtype"] = self.weight_dtype
+                snap["llm_kv_dtype"] = self.kv_dtype
+                snap["kv_pool_bytes"] = sum(
+                    int(math.prod(a.shape) * a.dtype.itemsize)
+                    for a in self.cache.values())
             if self.tp > 1:
                 # Sharding topology, riding the PR 6 chain as-is:
                 # Replica.stats() → controller probe → serve.status() /
@@ -1440,13 +1507,18 @@ class LLMEngine:
         return last_pos // self.page_size + 1
 
     def _pool_shard_bytes(self) -> int:
-        """Per-device bytes of the KV pool (K + V planes, null page
-        included). Page ids are shard-invariant — every shard holds
-        every page — so at tp > 1 each shard's cut is the head slice:
-        total pool bytes / tp. The topology number `serve.status()` /
-        `/api/serve/load` / the CLI render."""
-        k = self.cache["k"]
-        return int(2 * math.prod(k.shape) * k.dtype.itemsize) // self.tp
+        """Per-device bytes of the KV pool (K + V planes plus, when
+        quantized, the per-page scale planes; null page included). Page
+        ids are shard-invariant — every shard holds every page — so at
+        tp > 1 each K/V shard's cut is the head slice (total / tp)
+        while scale planes are replicated in full on every shard. The
+        topology number `serve.status()` / `/api/serve/load` / the CLI
+        render."""
+        total = 0
+        for key, a in self.cache.items():
+            nbytes = int(math.prod(a.shape) * a.dtype.itemsize)
+            total += nbytes if key.endswith("_scale") else nbytes // self.tp
+        return total
 
     def _alloc_page(self) -> int | None:
         """One exclusive page off the free list (refcount 1), or None
@@ -1572,23 +1644,24 @@ class LLMEngine:
             ids = np.zeros(width, np.int32)
             ids[:total_pages] = pages
             gathered = rt.gather_pages(self.cache, rt.jnp.asarray(ids))
-            k_host = np.asarray(gathered["k"])
-            v_host = np.asarray(gathered["v"])
-            dk_host = dv_host = None
+            # Dict-generic host pull: a quantized pool's k_scale/v_scale
+            # planes ride the SAME gather (every pool key is paged on
+            # axis 1), so payloads carry them with no extra bookkeeping.
+            host = {key: np.asarray(a) for key, a in gathered.items()}
+            dhost = None
             if self.spec_k:
                 # Draft pool mirror: draft page p ≡ target page p, so
                 # donations carry both and an adopting spec engine keeps
                 # the mirror exact (a spec adopter REQUIRES the draft
                 # planes — see _kv_adopt_plan).
                 dg = rt.gather_pages(self.draft_cache, rt.jnp.asarray(ids))
-                dk_host = np.asarray(dg["k"])
-                dv_host = np.asarray(dg["v"])
+                dhost = {key: np.asarray(a) for key, a in dg.items()}
             for d in new_depths:
                 s, e = self._kvo.page_span(d, c, self.page_size)
-                payload = {"k": k_host[:, s:e], "v": v_host[:, s:e]}
-                if dk_host is not None:
-                    payload["dk"] = dk_host[:, s:e]
-                    payload["dv"] = dv_host[:, s:e]
+                payload = {key: a[:, s:e] for key, a in host.items()}
+                if dhost is not None:
+                    for key, a in dhost.items():
+                        payload["d" + key] = a[:, s:e]
                 meta = self._kvo.make_meta(
                     keys[d - 1], d, c, self.page_size,
                     self._kv_fingerprint, self._kv_donor, e - s,
@@ -1700,27 +1773,31 @@ class LLMEngine:
             _KV_COUNTERS["adopt_failures"].inc(tags=tags)
             return 0
         rt = self._rt
-        k_data = np.concatenate([p["k"] for p in payloads], axis=1)
-        v_data = np.concatenate([p["v"] for p in payloads], axis=1)
         width = _pow2_width(n_pages)
         ids = np.zeros(width, np.int32)
         ids[:n_pages] = alloc
-        if width > n_pages:
-            pad = ((0, 0), (0, width - n_pages)) + ((0, 0),) * 3
-            k_data = np.pad(k_data, pad)
-            v_data = np.pad(v_data, pad)
+
+        def _stitch(pool, prefix=""):
+            # Dict-generic payload stitch: every pool key (K/V planes
+            # AND a quantized pool's scale planes) concatenates along
+            # the page axis and pads rank-generically, so the scatter
+            # is one fused dispatch per pool regardless of dtype.
+            data = {}
+            for key in pool:
+                a = np.concatenate([p[prefix + key] for p in payloads],
+                                   axis=1)
+                if width > n_pages:
+                    a = np.pad(a, ((0, 0), (0, width - n_pages))
+                               + ((0, 0),) * (a.ndim - 2))
+                data[key] = rt.jnp.asarray(a)
+            return data
+
         self.cache = rt.scatter_pages(
-            self.cache, rt.jnp.asarray(ids), rt.jnp.asarray(k_data),
-            rt.jnp.asarray(v_data))
+            self.cache, rt.jnp.asarray(ids), _stitch(self.cache))
         if self.spec_k:
-            dk = np.concatenate([p["dk"] for p in payloads], axis=1)
-            dv = np.concatenate([p["dv"] for p in payloads], axis=1)
-            if width > n_pages:
-                dk = np.pad(dk, pad)
-                dv = np.pad(dv, pad)
             self.draft_cache = rt.scatter_pages(
                 self.draft_cache, rt.jnp.asarray(ids),
-                rt.jnp.asarray(dk), rt.jnp.asarray(dv))
+                _stitch(self.draft_cache, prefix="d"))
         for i, pg in enumerate(alloc):
             self.page_table[slot, i] = pg
         self.slot_n_pages[slot] = n_pages

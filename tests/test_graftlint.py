@@ -324,6 +324,56 @@ def sibling_scopes(actor):
     assert res.findings == []
 
 
+def test_quant_upcast_fires(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax.numpy as jnp
+from ray_tpu.models.gpt import weight_view
+
+def forward(params, cfg):
+    w = params["wq"].astype(jnp.float32)      # whole-plane upcast
+    return w
+""", rules=[RULES_BY_ID["QUANT-UPCAST"]])
+    assert "QUANT-UPCAST" in rule_ids(res)
+    assert any('"wq"' in f.message for f in res.findings)
+
+
+def test_quant_upcast_clean(tmp_path):
+    res = lint_src(tmp_path, """\
+import jax.numpy as jnp
+from ray_tpu.models.gpt import quantize_params
+
+def dequant(plane, scale, dtype):
+    return plane.astype(dtype) * scale.astype(dtype)   # sanctioned site
+
+def weight_view(tree, name, dtype):
+    w = tree[name]
+    if w.dtype == jnp.int8:
+        return dequant(w, tree[name + "_scale"], dtype)
+    return w.astype(dtype)
+
+def io_roundtrip(params):
+    # Variable subscript: generic leaf iteration (checkpoint I/O).
+    return {k: params[k].astype(jnp.float32) for k in params}
+
+def norms(layer, cfg):
+    # Non-quantized leaves upcast freely.
+    return layer["ln1_scale"].astype(cfg.dtype)
+""", rules=[RULES_BY_ID["QUANT-UPCAST"]])
+    assert res.findings == []
+
+
+def test_quant_upcast_skips_non_quant_module(tmp_path):
+    # Same leaf names, but the module never touches the quantization
+    # machinery (the llama.py / moe_gpt.py family): out of scope.
+    res = lint_src(tmp_path, """\
+import jax.numpy as jnp
+
+def forward(params, cfg):
+    return params["wq"].astype(jnp.float32)
+""", rules=[RULES_BY_ID["QUANT-UPCAST"]])
+    assert res.findings == []
+
+
 # --------------------------------------------------- engine semantics
 
 def test_suppression_same_line_and_line_above(tmp_path):

@@ -15,6 +15,7 @@ from tools.graftlint.rules.jit import (
     JitInLoopRule,
     JitSideEffectRule,
 )
+from tools.graftlint.rules.quant import QuantUpcastRule
 from tools.graftlint.rules.recompile import RecompileHazardRule
 from tools.graftlint.rules.serialize import SerCaptureRule
 from tools.graftlint.rules.shardspec import ShardSpecRule
@@ -31,6 +32,7 @@ ALL_RULES = [
     RecompileHazardRule(),
     ShardSpecRule(),
     JaxCompatRule(),
+    QuantUpcastRule(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

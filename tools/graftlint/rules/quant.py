@@ -1,0 +1,98 @@
+"""QUANT-UPCAST: whole-tensor dequantization outside the blessed helper.
+
+Quantized serving keeps matmul weights as int8 planes + per-channel
+scale vectors and fuses the dequant into the consuming einsum
+(``gpt.weight_view`` → ``gpt.dequant``). The one way to silently lose
+the entire win is lexically tiny: ``params["wq"].astype(jnp.float32)``
+(or ``.astype(cfg.dtype)``) on the whole leaf inside model code — XLA
+materializes the full-precision plane in HBM and the decode step
+streams fat weights again, with zero behavioral signal (outputs stay
+numerically identical).
+
+Flagged: a ``.astype(...)`` call whose receiver is a SUBSCRIPT by one
+of the quantized weight names (``wq wk wv wo w_up w_down`` — the
+gpt.QUANT_RULES set) with a constant-string key, anywhere outside a
+function named ``dequant`` or ``weight_view`` (the sanctioned upcast
+sites; their whole point is that the cast feeds one fused consumer).
+Variable subscripts (``params[k]``) are not flagged — the key is
+unknowable lexically, and the generic-tree iteration idiom is how
+checkpoint I/O legitimately touches every leaf.
+
+Scope: only modules that touch the quantization machinery at all
+(reference ``quantize_params`` / ``weight_view`` / ``dequant``). Model
+families that share the leaf NAMES but never carry int8 planes
+(llama.py, moe_gpt.py — their params stay float and ``.astype`` is the
+correct read) are out of scope until the day they import the quantizer,
+at which point every whole-leaf upcast in them becomes a real finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.engine import FileContext, Finding, Rule
+
+# The rule-driven quantizer's plane names (models/gpt.QUANT_RULES).
+_QUANT_WEIGHT_NAMES = {"wq", "wk", "wv", "wo", "w_up", "w_down"}
+# Functions whose body IS the sanctioned dequant (the fused-read path).
+_SANCTIONED_FNS = {"dequant", "weight_view"}
+# Referencing any of these marks a module as quantization-aware.
+_QUANT_MARKERS = {"quantize_params", "weight_view", "dequant"}
+
+
+def _module_is_quant_aware(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in _QUANT_MARKERS:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _QUANT_MARKERS:
+            return True
+        if isinstance(node, ast.ImportFrom) and any(
+                a.name in _QUANT_MARKERS for a in node.names):
+            return True
+    return False
+
+
+def _quant_subscript_name(node: ast.AST) -> str | None:
+    """``<expr>["wq"]`` → "wq" when the key names a quantized plane."""
+    if not isinstance(node, ast.Subscript):
+        return None
+    key = node.slice
+    if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+            and key.value in _QUANT_WEIGHT_NAMES:
+        return key.value
+    return None
+
+
+class QuantUpcastRule(Rule):
+    id = "QUANT-UPCAST"
+    summary = ("whole quantized weight leaf .astype()'d outside "
+               "gpt.weight_view/dequant — re-materializes the full-"
+               "precision plane in HBM, defeating int8 serving")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        if not _module_is_quant_aware(ctx.tree):
+            return out
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                        and child.name in _SANCTIONED_FNS:
+                    continue
+                if isinstance(child, ast.Call) \
+                        and isinstance(child.func, ast.Attribute) \
+                        and child.func.attr == "astype":
+                    name = _quant_subscript_name(child.func.value)
+                    if name is not None:
+                        out.append(ctx.finding(
+                            self.id, child,
+                            f'quantized weight leaf "{name}" upcast '
+                            f'whole-tensor via .astype(...) — this '
+                            f're-materializes the full-precision plane '
+                            f'in HBM; read it through gpt.weight_view '
+                            f'(dequant fuses into the consuming einsum)'))
+                walk(child)
+
+        walk(ctx.tree)
+        return out
