@@ -171,6 +171,12 @@ def main() -> None:
     ap.add_argument("--prefill-budget", type=int, default=None,
                     help="max prefill tokens per engine tick while decode"
                          " is active (default: llm_prefill_token_budget)")
+    ap.add_argument("--no-width-bucketing", dest="width_bucketing",
+                    action="store_false", default=True,
+                    help="control arm: dispatch every prefill chunk at the"
+                         " full max_pages table width (the pre-bucketing"
+                         " two-program grid) instead of grouping rows by"
+                         " the pow-2 width their written prefix needs")
     ap.add_argument("--prefix-cache", action="store_true",
                     help="paged-KV prefix cache (serve/prefix_cache.py):"
                          " completed requests donate chunk-aligned prefix"
@@ -501,7 +507,11 @@ def main() -> None:
                        # every arm pins its dtypes, never a stray
                        # RAY_TPU_LLM_{WEIGHT,KV}_DTYPE.
                        weight_dtype=args.weight_dtype,
-                       kv_dtype=args.kv_dtype)
+                       kv_dtype=args.kv_dtype,
+                       # Explicit per arm: the full-width control arm
+                       # must pin False, never fall through to a stray
+                       # RAY_TPU_LLM_PREFILL_WIDTH_BUCKETING.
+                       prefill_width_bucketing=args.width_bucketing)
     # Shared-prefix workload: a small pool of "system prompts" that a
     # fraction of every prompt is drawn from. Built up front so the
     # multiset is deterministic regardless of client scheduling.
@@ -527,6 +537,13 @@ def main() -> None:
     else:
         prompt = lambda: list(
             rng.integers(0, cfg.vocab_size, args.prompt_len))
+    # Bucket-ladder warmup first: pre-compile every (table width, head)
+    # chunk program — the traffic warmup below only visits the widths
+    # its own prompts happen to cross, and a measured request crossing
+    # into an unvisited width would book seconds of XLA compile against
+    # one window (a non-zero jax_compiles_delta). Inert-row dispatches,
+    # marked via compile_watch.warmup_scope(), before compiles0 below.
+    engine.warmup_compile()
     for burst in (8, 4, 2):
         if burst <= args.n_slots:
             drive([engine.submit(prompt(), max_tokens=2)
@@ -670,6 +687,21 @@ def main() -> None:
         "jax_compiles_delta": int(
             compile_watch.compiles_total() - compiles0),
     }
+    if args.kv_mode == "paged" and args.prefill_chunk:
+        # Width-bucketed dispatch ablation surface: the per-bucket
+        # dispatch counts prove interior chunks ran at bucketed (not
+        # max_pages) width, and the p50/max pair is the bytes/chunk
+        # model's parameter in BENCH_SERVE.md.
+        row["prefill_width_bucketing"] = engine.prefill_width_bucketing
+        row["prefill_dispatches"] = em.get("prefill_dispatches", 0)
+        if "prefill_dispatch_width_p50" in em:
+            row["prefill_dispatch_width_p50"] = (
+                em["prefill_dispatch_width_p50"])
+            row["prefill_dispatch_width_max"] = (
+                em["prefill_dispatch_width_max"])
+        row["prefill_dispatch_widths"] = em.get(
+            "prefill_dispatch_widths", {})
+        row["max_pages_per_slot"] = engine.max_pages_per_slot
     if args.kv_mode == "paged":
         row["kv_pages_total"] = em.get("kv_pages_total")
         row["kv_page_size"] = em.get("kv_page_size")

@@ -43,6 +43,7 @@ case "$TIER" in
       tests/test_llm_serve.py         # LLM engine: paged KV, batching
       tests/test_paged_attention.py   # Pallas ragged paged-attn kernel
       tests/test_chunked_prefill.py   # chunked prefill + token budget
+      tests/test_width_bucketing.py   # pow-2 width-bucketed dispatch
       tests/test_prefix_cache.py      # prefix cache: COW page sharing
       tests/test_spec_decode.py       # speculative decode: verify/rollback
       tests/test_kv_objects.py        # KV page-set donate/adopt ladder
@@ -72,7 +73,8 @@ esac
 # the kernel tests silently (the module asserts the interpret-mode
 # fallback instead of importorskip'ing).
 for guarded in tests/test_tracing.py tests/test_paged_attention.py \
-               tests/test_chunked_prefill.py tests/test_prefix_cache.py \
+               tests/test_chunked_prefill.py tests/test_width_bucketing.py \
+               tests/test_prefix_cache.py \
                tests/test_spec_decode.py tests/test_kv_objects.py \
                tests/test_tp_decode.py tests/test_quant.py \
                tests/test_graftlint.py \

@@ -186,6 +186,33 @@ def label(fn_name: str):
         _tls.label = prev
 
 
+def in_warmup() -> bool:
+    """True while the current thread is inside a `warmup_scope()` block."""
+    return bool(getattr(_tls, "warmup", False))
+
+
+@contextlib.contextmanager
+def warmup_scope():
+    """Mark compiles on this thread as INTENTIONAL warmup (thread-local).
+
+    The serve engine's bucket-ladder warmup deliberately compiles every
+    width variant of the chunked prefill/verify programs back-to-back at
+    boot — log₂(max_pages)+1 widths × two head variants, well past the
+    storm threshold in well under the storm window. Those compiles are
+    the opposite of the storm detector's target (shape churn re-lowering
+    the SAME shape per call), so inside this scope they still count at
+    /metrics (`jax_compiles_total{fn}` — the bench's compile-delta
+    baseline is taken AFTER warmup) and still emit tracing spans, but
+    they do not feed the storm detector: a clean engine boot must never
+    file a `recompile.storm` cluster event."""
+    prev = getattr(_tls, "warmup", False)
+    _tls.warmup = True
+    try:
+        yield
+    finally:
+        _tls.warmup = prev
+
+
 def wrap(fn, name: str | None = None):
     """Attribution wrapper for a jitted callable we own: calls run under
     `name`, so compiles the listener observes during the call are labeled.
@@ -215,10 +242,13 @@ def wrap(fn, name: str | None = None):
 
 def record_compile(fn_name: str, duration_s: float) -> None:
     """Account one compile: counter + duration histogram + `jax.compile`
-    tracing span + storm-detector feed."""
+    tracing span + storm-detector feed (skipped inside `warmup_scope()`
+    — marked warmup compiles are intentional, not shape churn)."""
     _COMPILES_TOTAL.inc(1.0, tags={"fn": fn_name})
     _COMPILE_SECONDS.observe(duration_s, tags={"fn": fn_name})
     _emit_span(fn_name, duration_s)
+    if in_warmup():
+        return
     det = _storm
     if det is not None:
         det.observe(fn_name)
@@ -259,5 +289,5 @@ def storm_log() -> list[dict]:
 
 __all__ = [
     "install", "wrap", "label", "current_label", "record_compile",
-    "compiles_total", "storm_log",
+    "compiles_total", "storm_log", "warmup_scope", "in_warmup",
 ]

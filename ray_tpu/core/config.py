@@ -304,7 +304,11 @@ class Config:
     # with the dense engine) | "kernel" (Pallas ragged paged-attention:
     # K/V pages read in place with online softmax, no [B, T, H, K]
     # timeline in HBM — the throughput path on real chips; runs under
-    # interpret=True off-TPU). Env: RAY_TPU_LLM_ATTN_IMPL=kernel.
+    # interpret=True off-TPU) | "auto" (resolve at engine init: "kernel"
+    # when the default JAX backend is a TPU, "gather" elsewhere — one
+    # fleet-wide export serves both chip and CPU replicas). The default
+    # stays "gather" until the chip round confirms the kernel roofline
+    # (ROADMAP). Env: RAY_TPU_LLM_ATTN_IMPL=auto.
     llm_attn_impl: str = "gather"
     # Chunked prefill (paged mode only): prompts enter their slot's page
     # table in fixed-size chunks co-scheduled against decode instead of
@@ -314,6 +318,31 @@ class Config:
     # the prefill compile grid collapses from buckets × admission-ladder
     # to 2. Env: RAY_TPU_LLM_PREFILL_CHUNK=64.
     llm_prefill_chunk: int = 0
+    # Width-bucketed chunk dispatch (paged + chunked engines): chunk rows
+    # group by the pow-2 page width each row actually attends over
+    # (pages covering written tokens + this chunk — the `_pow2_width`
+    # rule shared with the decode table view), and every dispatch
+    # carries a table sliced to its bucket's width instead of the full
+    # max_pages_per_slot — interior chunks of a long-max-len engine stop
+    # paying attention bytes ∝ max_len. Programs lower per (width, head)
+    # pair: ≤ 2·log₂(max_pages)+2 total, pre-compiled by the engine's
+    # bucket-ladder warmup (start()/warmup_compile()). False = every
+    # chunk dispatch carries the full-width table (the PR 4 two-program
+    # grid; the bench ablation's control arm).
+    # Env: RAY_TPU_LLM_PREFILL_WIDTH_BUCKETING=0.
+    llm_prefill_width_bucketing: bool = True
+    # Bucket-ladder compile warmup at engine start(): pre-compile every
+    # (width, head) chunk-program variant — and the verify/draft ladder
+    # when speculation is on — before serving traffic, so a measured
+    # window pays zero XLA compiles (`jax_compiles_delta == 0`) no
+    # matter which widths traffic happens to hit first. Costs
+    # ~log₂(max_pages)+1 compiles per program at boot (marked via
+    # compile_watch.warmup_scope() so the recompile-storm detector stays
+    # quiet). Default off: short-lived engines (tests, notebooks) are
+    # better served compiling lazily; serving deployments and benches
+    # turn it on (benches may also call engine.warmup_compile()
+    # directly). Env: RAY_TPU_LLM_WARMUP_COMPILE=1.
+    llm_warmup_compile: bool = False
     # Max prefill tokens one engine tick may run while decode is active
     # (the decode-stall bound: a tick's prefill work never exceeds this).
     # 0 = pure-decode ticks (prefill only advances while nothing is

@@ -284,7 +284,10 @@ def _chunk_paged_forward(cfg: GPTConfig, params, tokens, pool, tables,
     # Write targets: pad/inert positions (rel >= n_valid) scatter to the
     # null page — harmless, read-masked. The page index is clamped
     # because a padded tail's absolute position can run past the table on
-    # a near-max-len prompt.
+    # a near-max-len prompt — and, with width-bucketed tables, past the
+    # sliced width on any row whose offset sits near the bucket edge.
+    # Only those write-masked pad positions ever hit the clamp: valid
+    # positions fall inside the sliced width by bucket construction.
     page_idx = jnp.minimum(pos // ps, tables.shape[1] - 1)
     row_pages = jnp.take_along_axis(tables, page_idx, axis=1)   # [N, C]
     write_pages = jnp.where(rel[None, :] < n_valid[:, None],
@@ -356,18 +359,26 @@ def prefill_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
     own arbitrary token offset (Sarathi/Orca-style chunked prefill, one
     fused dispatch per scheduler tick).
 
-    The compile-count fix for prefill: N and C are engine constants
-    (n_slots × chunk size), `offsets`/`n_valid` are traced vectors, and
-    `tables` are full-width page tables — so every chunk of every prompt
-    length, at any batch occupancy, lowers the same program. Exactly two
-    distinct prefill compilations total (``return_logits`` False for
-    interior-only batches, True when any row carries a final chunk, which
-    alone pays the LM head), replacing the one-shot path's
-    buckets × admission-ladder grid.
+    The compile-count story for prefill: N and C are engine constants
+    (n_slots × chunk size) and `offsets`/`n_valid` are traced vectors,
+    so the table WIDTH is the only shape degree of freedom — one program
+    lowers per (table width, ``return_logits``) pair. The engine slices
+    tables to the pow-2 width each bucket of rows actually attends over
+    (`_pow2_width` of pages covering written prefix + chunk), so the
+    grid is the width ladder {1, 2, 4, …, max_pages}: at most
+    2·log₂(max_pages)+2 programs (``return_logits`` False for
+    interior-only batches, True when any row carries a final chunk,
+    which alone pays the LM head), replacing the one-shot path's
+    buckets × admission-ladder grid. Full-width tables remain valid (the
+    width-bucketing-off control arm dispatches exactly the PR 4
+    two-program grid); attention compute/bytes scale with the sliced
+    width, which is the whole point for interior chunks of long-max-len
+    prompts.
 
-    tokens: [N, C] (row = slot; tail chunks padded); tables: [N,
-    max_pages] page ids (pages covering positions
-    ``offsets[i] .. offsets[i]+n_valid[i]-1`` must be allocated);
+    tokens: [N, C] (row = slot; tail chunks padded); tables: [N, width]
+    page ids, width ≤ max_pages (pages covering positions
+    ``offsets[i] .. offsets[i]+n_valid[i]-1`` must be allocated and fall
+    inside the sliced width — the engine's bucket rule guarantees this);
     offsets: [N] — absolute position of tokens[i, 0]; n_valid: [N] —
     valid tokens in row i's chunk (0 = inert row: all writes land on the
     null page and its logits row is garbage the engine ignores).
@@ -408,12 +419,15 @@ def verify_chunk_paged(cfg: GPTConfig, params, tokens, pool, tables,
     verify pass IS a chunked-prefill row — KV for the proposed tokens is
     scattered at arbitrary offsets and causally masked within the chunk,
     so the PR 4 chunk program (and its gather oracle) is the verify
-    program. Only the head differs: every position pays the LM head
-    (the k+1-wide full-logits head is the whole point — one weight pass
-    scores all proposals). The engine rolls rejected positions back by
-    rewinding cursors host-side; the garbage KV they leave behind sits
-    past every kv-length mask and is overwritten by the next write at
-    that position.
+    program, and it buckets by table width for free: the engine feeds
+    the decode-side width-sliced table view (`_decode_table_view`), so
+    one program lowers per pow-2 width — the log₂(max_pages)+1 half of
+    the chunk-program budget. Only the head differs: every position pays
+    the LM head (the k+1-wide full-logits head is the whole point — one
+    weight pass scores all proposals). The engine rolls rejected
+    positions back by rewinding cursors host-side; the garbage KV they
+    leave behind sits past every kv-length mask and is overwritten by
+    the next write at that position.
 
     → (logits [N, C, V] fp32, updated pool).
     """
@@ -734,7 +748,10 @@ def prefill_chunk_paged_tp(cfg: GPTConfig, params, tokens, pool, tables,
     """`prefill_chunk_paged` over a tp mesh: the chunk body runs
     per-head-shard; the LM head (replicated weights, replicated hidden
     states after the body's psums) runs outside the shard_map so the
-    logits row selection is identical to the single-shard program."""
+    logits row selection is identical to the single-shard program.
+    Tables ride through replicated (pages are indexed by id; only the
+    head dim is sliced) — width-bucketed table views cost one program
+    per pow-2 width here exactly as in the single-shard twin."""
     if attn_impl not in ("gather", "kernel"):
         raise ValueError(
             f"attn_impl must be gather|kernel, got {attn_impl!r}")
@@ -761,7 +778,8 @@ def verify_chunk_paged_tp(cfg: GPTConfig, params, tokens, pool, tables,
                           offsets, n_valid, *, mesh,
                           attn_impl: str = "gather"):
     """`verify_chunk_paged` over a tp mesh (same body/head split as
-    `prefill_chunk_paged_tp`; every position pays the replicated head)."""
+    `prefill_chunk_paged_tp`; every position pays the replicated head;
+    tables may be width-sliced exactly as in the single-shard twin)."""
     if attn_impl not in ("gather", "kernel"):
         raise ValueError(
             f"attn_impl must be gather|kernel, got {attn_impl!r}")

@@ -303,9 +303,14 @@ def paged_prefill_attention(
         ``k_scale``/``v_scale`` [P] per-page scale vectors, handled
         exactly as in `paged_attention`.
       tables: [B, n_pg] int32 page ids per slot (unallocated tail = 0).
+        n_pg may be a WIDTH-SLICED view of the engine's full page table
+        (the pow-2 bucket covering each row's written prefix + chunk):
+        the grid is (B, n_pg), so compute and pool-page bytes scale with
+        the sliced width — interior chunks of a long-max-len prompt pay
+        for the prefix they attend over, not for max_pages.
       offsets: [B] int32 absolute position of q[:, 0].
       lengths: [B] int32 valid kv positions per slot (= offset + valid
-        chunk tokens).
+        chunk tokens; must satisfy lengths[b] <= n_pg * page_size).
     Returns [B, C, H, K] in q.dtype; rows past a slot's valid chunk tokens
     are defined but meaningless (the engine discards them)."""
     B, C, H, K = q.shape
@@ -412,7 +417,11 @@ def reference_paged_prefill_attention(q, k_pool, v_pool, tables, offsets,
     exact-semantics default off-TPU; also the kernel's test oracle).
 
     q: [B, C, H, K]; offsets/lengths: [B] (lengths = offset + valid chunk
-    tokens). → [B, C, H, K] in q.dtype."""
+    tokens). `tables` may be a width-sliced view (see
+    `paged_prefill_attention`): the reconstituted timeline T =
+    tables.shape[1] · page_size shrinks with the bucket width, so the
+    oracle's gather/einsum bytes scale the same way the kernel's grid
+    does. → [B, C, H, K] in q.dtype."""
     B, C, H, K = q.shape
     ps = k_pool.shape[1]
     T = tables.shape[1] * ps

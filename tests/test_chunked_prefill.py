@@ -5,9 +5,10 @@ byte-identical to the one-shot paged engine (itself exact-match with the
 dense engine) for every chunk size, ragged prompt lengths, both attention
 implementations, and under preempt-by-recompute pool pressure. Then the
 scheduler contracts: the per-tick prefill token budget is a hard cap
-(budget 0 = pure decode ticks), the chunked path lowers at most TWO
-distinct prefill programs (vs the one-shot buckets × admission-ladder
-grid), and a page-blocked queue head no longer head-of-line-blocks
+(budget 0 = pure decode ticks), the chunked path lowers within the pow-2
+width-ladder budget — 2·log₂(max_pages)+2 programs bucketed, exactly two
+with bucketing off (vs the one-shot buckets × admission-ladder grid) —
+and a page-blocked queue head no longer head-of-line-blocks
 admission. The prefill kernel runs under interpret=True off-TPU, like the
 decode kernel (tests/test_paged_attention.py).
 """
@@ -171,10 +172,14 @@ class TestExactness:
 
 
 class TestCompileCount:
-    def test_chunked_path_lowers_at_most_two_programs(self, params):
+    def test_chunked_path_lowers_within_width_ladder_budget(self, params):
         """The whole point of the fixed chunk shape: ragged prompt
         lengths, multi-chunk and single-chunk prompts, partial tails —
-        ONE interior program + ONE final program, not buckets × ladder."""
+        at most one (interior, final) program pair PER pow-2 table
+        width, not buckets × ladder. This geometry (max_len 128, page
+        size 16 → max_pages 8) allows widths {1, 2, 4, 8}: budget
+        2·log₂(8)+2 = 8. The width-bucketing-off control arm below
+        keeps the original PR 4 pin of exactly two."""
         from ray_tpu.models.paged_kv import prefill_chunk_paged
 
         prefill_chunk_paged.clear_cache()
@@ -182,6 +187,19 @@ class TestCompileCount:
             np.random.default_rng(5), (3, 16, 17, 33, 50, 64, 7))
         chunked, _ = _run(params, prompts, kv_mode="paged", page_size=16,
                           prefill_chunk=16, prefill_token_budget=32)
+        assert prefill_chunk_paged._cache_size() <= 8
+
+    def test_fullwidth_control_arm_keeps_two_program_pin(self, params):
+        """`prefill_width_bucketing=False` restores the PR 4 contract
+        bit-for-bit: every dispatch at max_pages width, two programs."""
+        from ray_tpu.models.paged_kv import prefill_chunk_paged
+
+        prefill_chunk_paged.clear_cache()
+        prompts = _ragged_prompts(
+            np.random.default_rng(5), (3, 16, 17, 33, 50, 64, 7))
+        chunked, _ = _run(params, prompts, kv_mode="paged", page_size=16,
+                          prefill_chunk=16, prefill_token_budget=32,
+                          prefill_width_bucketing=False)
         assert prefill_chunk_paged._cache_size() <= 2
 
     def test_oneshot_stream_unaffected_by_cache_clear(self, params):
