@@ -57,6 +57,7 @@ case "$TIER" in
       tests/test_sharding_audit.py    # SPMD audit arithmetic
       tests/test_graftlint.py         # static-analysis rules + baseline
       tests/test_graftlint_v2.py      # flow-aware families + compat shim
+      tests/test_graftlint_v3.py      # concurrency/lifecycle families
       tests/test_flight_recorder.py   # compile watch / load / SLO
       tests/test_autoscale.py         # series store + shadow autoscaler
       tests/test_router.py            # load/affinity routing + shedding
@@ -78,7 +79,8 @@ for guarded in tests/test_tracing.py tests/test_paged_attention.py \
                tests/test_spec_decode.py tests/test_kv_objects.py \
                tests/test_tp_decode.py tests/test_quant.py \
                tests/test_graftlint.py \
-               tests/test_graftlint_v2.py tests/test_flight_recorder.py \
+               tests/test_graftlint_v2.py tests/test_graftlint_v3.py \
+               tests/test_flight_recorder.py \
                tests/test_autoscale.py tests/test_router.py \
                tests/test_chaos.py; do
   collected=$(python -m pytest "${guarded}" --collect-only -q \
@@ -99,11 +101,13 @@ done
 # committed baseline (fresh forks): advisory-only, since every
 # historical finding would read as "new" there.
 if [ "$TIER" = "fast" ] || [ "$TIER" = "quick" ]; then
+  # --jobs 0 = one worker per core: the v3 flow rules walk every class
+  # model per file, and the scan is embarrassingly parallel.
   if [ -f tools/graftlint/baseline.json ]; then
-    python -m tools.graftlint ray_tpu/ tools/
+    python -m tools.graftlint ray_tpu/ tools/ --jobs 0
   else
     echo "ci.sh: no graftlint baseline committed — advisory lint only" >&2
-    python -m tools.graftlint ray_tpu/ tools/ || true
+    python -m tools.graftlint ray_tpu/ tools/ --jobs 0 || true
   fi
 fi
 

@@ -134,7 +134,11 @@ class CoreClient:
         self._put_counter = itertools.count(1)
         self._memory_store: dict[bytes, Any] = {}
         self._mmaps: dict[bytes, memoryview] = {}
+        # Writes hold _actors_lock: actor_state()'s get-or-create runs on
+        # arbitrary submitter threads, and two racing calls for the same id
+        # would each install a distinct ActorState (split ready-events).
         self._actors: dict[bytes, ActorState] = {}
+        self._actors_lock = threading.Lock()
         self._worker_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._raylet_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._result_events: dict[bytes, threading.Event] = {}
@@ -608,6 +612,7 @@ class CoreClient:
                     view = attach_extent(name, offset, size)
                     self._mmaps[key] = view
                     value = serialization.unpack(view)
+                # graftlint: disable=GUARDED-BY (idempotent per-key cache refill: a racing free() re-evicts on the next release; a racing get() installs the identical value)
                 self._memory_store[key] = value
                 out[i] = value
             missing = still
@@ -1475,7 +1480,8 @@ class CoreClient:
         resources = resources or {"CPU": 1}
         st = ActorState(actor_id)
         st.resources = resources
-        self._actors[actor_id] = st
+        with self._actors_lock:
+            self._actors[actor_id] = st
         # Trace capture must happen in the SUBMITTING thread — the coroutine
         # below runs on the client's event loop, whose context is empty.
         trace_ctx = tracing.capture_for_submission()
@@ -1536,7 +1542,8 @@ class CoreClient:
                     )
                     if existing.address:
                         existing.ready.set()
-                    self._actors[info["actor_id"]] = existing
+                    with self._actors_lock:
+                        self._actors[info["actor_id"]] = existing
                     return info["actor_id"]
             raise RuntimeError(reg.get("error", "actor registration failed"))
         self._ensure_bg(self._place_actor(
@@ -1617,11 +1624,12 @@ class CoreClient:
         self._release_escrow_ids(escrow, st.creation_return_id)
 
     def actor_state(self, actor_id: bytes) -> ActorState:
-        st = self._actors.get(actor_id)
-        if st is None:
-            st = ActorState(actor_id)
-            self._actors[actor_id] = st
-        return st
+        with self._actors_lock:
+            st = self._actors.get(actor_id)
+            if st is None:
+                st = ActorState(actor_id)
+                self._actors[actor_id] = st
+            return st
 
     def submit_actor_task(
         self,

@@ -64,8 +64,12 @@ class ReferenceCounter:
         # a live object.
         self._owned: set[bytes] = set()
         # mmap views whose release hit BufferError (a live zero-copy value
-        # still exports the buffer); retried each flush tick.
+        # still exports the buffer); retried each flush tick. Handoff is a
+        # lock-free deque (same GC-safety contract as _del_queue below):
+        # defer_local runs in GC context and must not take locks, and the
+        # retry set itself is touched only on the flusher thread.
         self._deferred_local: set[bytes] = set()
+        self._deferred_local_q: collections.deque[bytes] = collections.deque()
         # Decrefs queued from ObjectRef.__del__: finalizers can run inside
         # the cyclic GC on a thread that already holds _lock or the client's
         # lineage lock — taking a non-reentrant lock there can self-deadlock.
@@ -198,7 +202,10 @@ class ReferenceCounter:
             }
 
     def forget_contains(self, outer: bytes) -> None:
-        self._registered_contains.pop(outer, None)
+        # registration_payload() iterates this dict under _lock; an
+        # unlocked pop here can resize it mid-iteration.
+        with self._lock:
+            self._registered_contains.pop(outer, None)
 
     def add_contains(self, outer: bytes, inners: Iterable[bytes]) -> None:
         """Record that the stored object `outer`'s serialized value embeds
@@ -283,7 +290,10 @@ class ReferenceCounter:
         # Containment registered — remember it for failover re-registration
         # and drop the escrow holds on the inners.
         for outer, inners in contains:
-            self._registered_contains.setdefault(outer, []).extend(inners)
+            # Lock only the dict mutation — decref takes _lock itself.
+            with self._lock:
+                self._registered_contains.setdefault(
+                    outer, []).extend(inners)
             for oid in inners:
                 self.decref(oid)
 
@@ -312,12 +322,21 @@ class ReferenceCounter:
             logger.debug("flush_now failed: %s", e)
 
     def _retry_deferred_local(self) -> None:
+        # Flusher thread only: drain the GC-side queue into the private
+        # retry set, then retry. No lock needed — the queue handoff is the
+        # synchronization point.
+        while True:
+            try:
+                self._deferred_local.add(self._deferred_local_q.popleft())
+            except IndexError:
+                break
         for oid in list(self._deferred_local):
             if self._client._try_release_mmap(oid):
                 self._deferred_local.discard(oid)
 
     def defer_local(self, oid: bytes) -> None:
-        self._deferred_local.add(oid)
+        """GC-safe: lock-free enqueue (same contract as decref_deferred)."""
+        self._deferred_local_q.append(oid)
 
     def close(self) -> None:
         self._closed = True

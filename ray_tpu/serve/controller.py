@@ -106,6 +106,11 @@ class ServeController:
         # strikes to one per probe window: reconciles can overlap (the
         # background loop plus deploy/request_scale_up-scoped ones), and
         # double-counting one wedged window would defeat the threshold.
+        # Own lock (not self._lock): strikes are recorded in the probe
+        # section, which deliberately runs outside self._lock because it
+        # blocks on ray_tpu.wait/get — but the strike read-modify-write
+        # still needs mutual exclusion across overlapping reconciles.
+        self._health_lock = threading.Lock()
         self._health_fails: dict[str, tuple[int, float]] = {}
         from ray_tpu.core.config import runtime_config
 
@@ -223,6 +228,7 @@ class ServeController:
                             if seq != self._ckpt_seq:
                                 return  # a newer snapshot supersedes this
                         _chaos.hit("serve.controller.ckpt_write")
+                        # graftlint: disable=LOCK-ORDER (holding the RPC inside _ckpt_write_lock IS the design: this single-purpose lock serializes checkpoint writers only — reconcile/deploy contend on self._lock, which is released before the RPC)
                         _api._ensure_client().kv_put(
                             CKPT_NS, CKPT_KEY, bytes(blob))
                     return
@@ -864,29 +870,35 @@ class ServeController:
                     drop_start.add(aid)
                 continue
             if died:
-                self._health_fails.pop(aid, None)  # definitively dead
+                with self._health_lock:
+                    self._health_fails.pop(aid, None)  # definitively dead
                 drop.add(aid)
             elif ok:
-                self._health_fails.pop(aid, None)
+                with self._health_lock:
+                    self._health_fails.pop(aid, None)
             else:
                 # Timeout / transient: strike, but keep the replica in
                 # rotation until the consecutive-failure threshold — it
                 # contributes no stats this tick. At most one strike per
-                # probe window (overlapping reconciles share the window).
+                # probe window (overlapping reconciles share the window —
+                # the lock makes the get→store below atomic against them).
                 now = time.monotonic()
-                n, last = self._health_fails.get(aid, (0, 0.0))
-                if now - last >= probe_timeout * 0.5:
-                    n += 1
-                    self._health_fails[aid] = (n, now)
+                with self._health_lock:
+                    n, last = self._health_fails.get(aid, (0, 0.0))
+                    if now - last >= probe_timeout * 0.5:
+                        n += 1
+                        self._health_fails[aid] = (n, now)
+                    if n >= fail_limit:
+                        self._health_fails.pop(aid, None)
                 if n >= fail_limit:
-                    self._health_fails.pop(aid, None)
                     drop.add(aid)
         # Drop strike bookkeeping for replicas no longer tracked anywhere.
         if only is None:
             seen_aids = {aid for (_n, aid, _r, _s) in probes}
-            for aid in list(self._health_fails):
-                if aid not in seen_aids:
-                    del self._health_fails[aid]
+            with self._health_lock:
+                for aid in list(self._health_fails):
+                    if aid not in seen_aids:
+                        del self._health_fails[aid]
         start_timeout = getattr(
             self._cfg, "serve_replica_start_timeout_s", 180.0)
         load_refreshed = False

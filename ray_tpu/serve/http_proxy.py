@@ -174,6 +174,7 @@ class _RouterMixin:
         self._rlock = threading.Lock()
         self._route_dirty = threading.Event()
         self._route_dirty.set()
+        self._router_stop = threading.Event()
         try:
             from ray_tpu import api as _api
             from ray_tpu.serve.controller import ROUTES_CHANNEL
@@ -212,9 +213,11 @@ class _RouterMixin:
         import ray_tpu
         from ray_tpu.serve.api import _get_controller
 
-        while True:
+        while not self._router_stop.is_set():
             self._route_dirty.wait(timeout=5.0)
             self._route_dirty.clear()
+            if self._router_stop.is_set():
+                return
             try:
                 ctrl = _get_controller()
                 table = ray_tpu.get(ctrl.get_routing.remote(-1), timeout=30)
@@ -230,6 +233,13 @@ class _RouterMixin:
                 # permanently failing refresh must not be invisible.
                 logger.debug("route table refresh failed (serving stale "
                              "routes): %s", e)
+
+    def _close_router(self):
+        """Stop the refresher thread (graceful proxy shutdown — a killed
+        actor process takes the daemon thread with it either way)."""
+        self._router_stop.set()
+        self._route_dirty.set()   # wake the 5s safety-net wait immediately
+        self._refresher.join(timeout=5)
 
 
 class HTTPProxy(_RouterMixin):
@@ -778,6 +788,15 @@ class HTTPProxy(_RouterMixin):
     def health(self) -> bool:
         return True
 
+    def close(self) -> None:
+        """Graceful stop: refresher joined, event loop stopped, server
+        thread joined, submission pool drained. Idempotent."""
+        self._close_router()
+        if self._thread.is_alive():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+        self._pool.shutdown(wait=False)
+
 
 class ThreadedHTTPProxy(_RouterMixin):
     """v1 ingress (stdlib ThreadingHTTPServer): one thread per in-flight
@@ -974,6 +993,12 @@ class ThreadedHTTPProxy(_RouterMixin):
 
     def health(self) -> bool:
         return True
+
+    def close(self) -> None:
+        self._close_router()
+        self._server.shutdown()      # serve_forever returns
+        self._thread.join(timeout=10)
+        self._server.server_close()
 
 
 def start_proxy(port: int = 0, impl: str = "async"):

@@ -193,7 +193,10 @@ class LocalKVStore:
                 if dead or expired:
                     del self._entries[key]
                     freed += 1
-        self.withdrawals += freed
+            # Inside the lock: withdraw()/donate() bump this counter under
+            # it too, and an unguarded += is a read-modify-write that loses
+            # counts against a concurrent withdraw.
+            self.withdrawals += freed
         return freed
 
     def stats(self) -> dict:
@@ -248,7 +251,12 @@ class ObjectKVStore:
         return meta
 
     def _withdraw_entry(self, key: str, ref) -> None:
-        self.withdrawals += 1
+        # Callers (donate's budget eviction, withdraw) invoke this AFTER
+        # releasing _lock — the kv_get/kv_del below are RPCs that must not
+        # run under it. The counter bump still needs the lock: += races a
+        # concurrent donate's bump otherwise.
+        with self._lock:
+            self.withdrawals += 1
         try:
             # Compare-and-delete: only remove the index row if it still
             # points at OUR object. After a TTL sweep reaped this

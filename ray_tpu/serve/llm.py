@@ -968,6 +968,11 @@ class LLMEngine:
         self._mid_tick = False
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # Serializes start()/stop(): two concurrent start() calls would
+        # both see _thread is None and spawn two engine loops. Separate
+        # from _lock — stop() joins the loop thread while holding it, and
+        # the loop thread takes _lock on every tick.
+        self._lifecycle_lock = threading.Lock()
         self.stats = {"requests": 0, "tokens_generated": 0,
                       "ttft_sum": 0.0, "completed": 0,
                       # Engine-side split (device dispatch + sync wall
@@ -1183,12 +1188,14 @@ class LLMEngine:
                 tables = jnp.asarray(
                     np.zeros((self.n_slots, width), np.int32))
                 for head in (False, True):
+                    # graftlint: disable=GUARDED-BY (warmup runs before the engine thread exists: start() calls it pre-spawn under _lifecycle_lock, and direct callers own the engine single-threaded)
                     _x, self.cache = rt.prefill_chunk_paged(
                         self.cfg, self.params, toks, self.cache, tables,
                         zeros, zeros, return_logits=head,
                         attn_impl=self.attn_impl)
                     n += 1
                 if self.spec_k:
+                    # graftlint: disable=GUARDED-BY (pre-spawn, see above)
                     _x, self.draft_cache = rt.prefill_chunk_paged(
                         self.draft_cfg, self.draft_params, toks,
                         self.draft_cache, tables, zeros, zeros,
@@ -1197,22 +1204,25 @@ class LLMEngine:
                         self.cfg, self.params, vtoks, self.cache, tables,
                         zeros, zeros, attn_impl=self.attn_impl)
                     n += 2
+        # graftlint: disable=GUARDED-BY (pre-spawn, see above)
         self._warmed = True
         return n
 
     def start(self) -> None:
-        if self._thread is None:
-            if self._warmup_on_start:
-                self.warmup_compile()
-            self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="llm-engine")
-            self._thread.start()
+        with self._lifecycle_lock:
+            if self._thread is None:
+                if self._warmup_on_start:
+                    self.warmup_compile()
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="llm-engine")
+                self._thread.start()
 
     def stop(self) -> None:
         self._shutdown.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            self._thread = None
+        with self._lifecycle_lock:
+            if self._thread is not None:
+                self._thread.join(timeout=30)
+                self._thread = None
 
     def drain(self, timeout_s: float) -> dict:
         """Drain protocol: stop admission, let in-flight decodes finish,
@@ -1300,6 +1310,7 @@ class LLMEngine:
                     self.prefix_cache.release(entry)
                 if int(self.slot_n_pages[slot]):
                     self._free_slot_pages(slot)
+                # graftlint: disable=GUARDED-BY (single-threaded by protocol: _export_unfinished runs after stop() joined the engine thread — see its docstring — so nothing races these resets)
                 self.positions[slot] = 0
                 self.tokens[slot] = 0
         out = []
@@ -3056,6 +3067,7 @@ class LLMEngine:
                   and i not in self._chunk_pos]
         n_prefilling = len(self._prefilling)
         if not active:
+            # graftlint: disable=GUARDED-BY (engine-thread state: _step runs only on the engine loop thread; the locked writes elsewhere are reader-side snapshots, and a plain store is torn-read-free)
             self._last_window_end = None
             return n_prefilling
         tick_prefill = self.stats["prefill_tokens"] > pt0
@@ -3082,6 +3094,7 @@ class LLMEngine:
             self._rng_key, sub = rt.jax.random.split(self._rng_key)
             with self._window_span():
                 if self.kv_mode == "paged":
+                    # graftlint: disable=GUARDED-BY (engine-thread state: only _step writes the KV cache while the loop runs; drain/export mutate it after stop() joins the thread)
                     toks_out, self.cache = rt.decode_multi_paged(
                         self.cfg, self.params, jnp.asarray(self.tokens),
                         self.cache, jnp.asarray(self.positions),
@@ -3106,7 +3119,9 @@ class LLMEngine:
                 if finished:
                     self._release(slot)
                 else:
+                    # graftlint: disable=GUARDED-BY (engine-thread state, see cache note above)
                     self.tokens[slot] = toks_out[k - 1, slot]
+                    # graftlint: disable=GUARDED-BY (engine-thread state, see cache note above)
                     self.positions[slot] += k
             return len(active) + n_prefilling
         with self._window_span():

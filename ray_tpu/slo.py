@@ -129,9 +129,19 @@ class SloMonitor:
         if now is None:
             now = time.monotonic()
         out = []
+        pending: list[tuple[str, dict]] = []
         with self._lock:
             for obj in self.objectives:
-                out.append(self._evaluate_one(obj, rows, now))
+                out.append(self._evaluate_one(obj, rows, now, pending))
+        # Emit AFTER the lock drops: emit_cluster_event is an RPC, and a
+        # slow GCS under the lock would stall every concurrent evaluate().
+        if self._export:
+            from ray_tpu import state as _state
+
+            for msg, ev in pending:
+                _state.emit_cluster_event("slo.violation", msg,
+                                          severity="WARNING", source="slo",
+                                          **ev)
         return out
 
     # ------------------------------------------------------------ internals
@@ -165,7 +175,7 @@ class SloMonitor:
         return boundaries, buckets
 
     def _evaluate_one(self, obj: Objective, rows: list[dict],
-                      now: float) -> dict:
+                      now: float, pending: list | None = None) -> dict:
         base = {"name": obj.name, "metric": obj.metric,
                 "quantile": obj.quantile, "threshold_s": obj.threshold_s,
                 "window_s": obj.window_s}
@@ -233,15 +243,13 @@ class SloMonitor:
                   "threshold_s": obj.threshold_s,
                   "window_s": obj.window_s, "samples": status["samples"]}
             self.events.append(ev)
-            if self._export:
-                from ray_tpu import state as _state
-
-                _state.emit_cluster_event(
-                    "slo.violation",
+            if pending is not None:
+                # Queued for the caller to emit outside self._lock (the
+                # event push is an RPC; see evaluate()).
+                pending.append((
                     f"SLO {obj.name} violating: p{int(obj.quantile * 100)}"
                     f"≈{status['quantile_est_s']:g}s > {obj.threshold_s:g}s "
-                    f"target (burn {status['burn_rate']:g})",
-                    severity="WARNING", source="slo", **ev)
+                    f"target (burn {status['burn_rate']:g})", ev))
         self._violating[obj.name] = violating
         return status
 

@@ -169,6 +169,31 @@ class TestLocalStore:
         assert set(st.resolve([f"k{i}" for i in range(4)])) == {"k2", "k3"}
         assert st.withdrawals == 2
 
+    def test_withdrawals_counter_guarded_by_lock(self):
+        """GUARDED-BY (PR 19): sweep() bumped `withdrawals` after
+        releasing `_lock` while withdraw()/donate() bump it inside —
+        a sweep racing a withdraw loses counts (read-modify-write on
+        an unguarded int). Pin: every write of the counter happens
+        with the store lock held."""
+
+        class Probe(LocalKVStore):
+            def __setattr__(self, name, value):
+                if name == "withdrawals" and self.__dict__.get("_probe_on"):
+                    self.__dict__.setdefault("locked_at_write", []).append(
+                        self._lock.locked())
+                object.__setattr__(self, name, value)
+
+        st = Probe(budget=8)
+        st._probe_on = True
+        for i in range(3):
+            st.donate(make_meta(f"k{i}", 1, 16, 16, "fp", "d0", 1, False),
+                      {"k": np.zeros(1), "v": np.zeros(1)})
+        assert st.withdraw("k0")
+        assert st.sweep(live_donors=set()) == 2
+        assert st.withdrawals == 3
+        assert st.locked_at_write and all(st.locked_at_write), \
+            f"withdrawals written without _lock held: {st.locked_at_write}"
+
     def test_withdraw_is_compare_and_delete(self):
         """A donor withdrawing its own STALE donation (its index row
         already swept and re-published by another donor) must not

@@ -174,3 +174,322 @@ def module_graph(ctx) -> ModuleGraph:
     if "callgraph" not in ctx.cache:
         ctx.cache["callgraph"] = ModuleGraph(ctx.tree)
     return ctx.cache["callgraph"]
+
+
+# ---------------------------------------------------------------------------
+# v3 substrate: with-extent tracking, attr-access classification, thread
+# entry-point discovery. Per-class and *function-scoped*: a `with self._lock:`
+# extent covers the statements lexically inside it in THAT function only —
+# a nested def does not inherit the enclosing extent (it runs later, usually
+# on another thread), so it is modeled as its own pseudo-method.
+# ---------------------------------------------------------------------------
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_THREAD_FACTORIES = {"Thread", "Timer"}
+_TASK_SPAWNERS = {"create_task", "ensure_future", "run_coroutine_threadsafe"}
+# Calls on a container attribute that mutate it in place.
+_MUTATORS = {"append", "appendleft", "add", "extend", "insert", "pop",
+             "popleft", "popitem", "remove", "discard", "clear", "update",
+             "setdefault", "sort", "reverse"}
+# Calls on an attribute that read its VALUE (vs. e.g. `.set()`/`.join()`
+# which act on the object without exposing state the caller computes on).
+_VALUE_READERS = {"get", "items", "keys", "values", "copy", "count",
+                  "index", "qsize", "empty", "snapshot", "is_set"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """`self.X` → "X"; anything else → None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class AttrAccess:
+    """One touch of a `self.X` attribute inside one method body."""
+
+    attr: str
+    kind: str                     # "read" | "write" | "iter"
+    node: ast.AST
+    locks: tuple[str, ...]        # self-lock attrs held here (fn-scoped)
+    method: str
+    rmw: bool = False             # read-modify-write (augmented assignment)
+    # "value" when the attribute's VALUE flows into the computation
+    # (subscript, compare, plain load, `.get()/.items()`-style readers);
+    # "other" for bound-method refs (`cb(self._tasks.discard)`) and calls
+    # like `.join()`/`.set()` that don't expose state to compute on.
+    via: str = "value"
+
+
+@dataclasses.dataclass
+class MethodModel:
+    name: str
+    node: ast.AST
+    accesses: list[AttrAccess]
+    # (lock attr, locks already held, acquisition site) per `with self.X:`
+    acquisitions: list[tuple[str, tuple[str, ...], ast.AST]]
+    # (call site, self-method callee or None, locks held at the call)
+    calls: list[tuple[ast.Call, str | None, tuple[str, ...]]]
+
+
+@dataclasses.dataclass
+class ClassModel:
+    node: ast.ClassDef
+    name: str
+    lock_attrs: set[str]
+    methods: dict[str, MethodModel]      # incl. "<outer>.<nested>" pseudo
+    entry_points: dict[str, str]         # method name → why it is one
+    # (thread attr, target method name or None, assignment site)
+    stored_threads: list[tuple[str, str | None, ast.AST]]
+    starts_threads: bool = False
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Walk ONE function body tracking the `with self.<lock>:` stack."""
+
+    def __init__(self, model: MethodModel, lock_attrs: set[str],
+                 parents: dict):
+        self.m = model
+        self.lock_attrs = lock_attrs
+        self.parents = parents
+        self.stack: list[str] = []
+
+    # Nested defs/lambdas run later (often on another thread): they do not
+    # inherit this function's lock extents and are analyzed separately.
+    def visit_FunctionDef(self, node):  # noqa: D102
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _with(self, node):
+        pushed = 0
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and attr in self.lock_attrs:
+                self.m.acquisitions.append(
+                    (attr, tuple(self.stack), item.context_expr))
+                self.stack.append(attr)
+                pushed += 1
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        if pushed:
+            del self.stack[-pushed:]
+
+    visit_With = _with
+    visit_AsyncWith = _with
+
+    def visit_Call(self, node):
+        callee = None
+        if isinstance(node.func, ast.Attribute):
+            callee = _self_attr(node.func)
+        self.m.calls.append((node, callee, tuple(self.stack)))
+        self.generic_visit(node)
+
+    def _iter_attrs(self, expr: ast.AST):
+        """self attrs an iteration expression walks (incl. through
+        `list(...)` copies and `.items()/.keys()/.values()` views)."""
+        for n in ast.walk(expr):
+            attr = _self_attr(n)
+            if attr is not None and isinstance(n.ctx, ast.Load):
+                yield attr, n
+
+    def _record_iter(self, expr: ast.AST):
+        for attr, n in self._iter_attrs(expr):
+            self.m.accesses.append(AttrAccess(
+                attr=attr, kind="iter", node=n, locks=tuple(self.stack),
+                method=self.m.name))
+
+    def _for(self, node):
+        self._record_iter(node.iter)
+        self.generic_visit(node)
+
+    visit_For = _for
+    visit_AsyncFor = _for
+
+    def _comp(self, node):
+        for gen in node.generators:
+            self._record_iter(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _comp
+    visit_SetComp = _comp
+    visit_DictComp = _comp
+    visit_GeneratorExp = _comp
+
+    def visit_Attribute(self, node):
+        attr = _self_attr(node)
+        if attr is None:
+            self.generic_visit(node)
+            return
+        kind, rmw, via = "read", False, "value"
+        parent = self.parents.get(id(node))
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            kind = "write"
+            rmw = isinstance(parent, ast.AugAssign) and parent.target is node
+        elif isinstance(parent, ast.Attribute) and parent.attr in _MUTATORS:
+            gp = self.parents.get(id(parent))
+            if isinstance(gp, ast.Call) and gp.func is parent:
+                kind = "write"
+            else:
+                via = "other"     # bound mutator passed as a callback
+        elif isinstance(parent, ast.Subscript) and parent.value is node:
+            if isinstance(parent.ctx, (ast.Store, ast.Del)):
+                kind = "write"
+                gp = self.parents.get(id(parent))
+                rmw = isinstance(gp, ast.AugAssign) and gp.target is parent
+        elif isinstance(parent, ast.Attribute) and parent.value is node:
+            gp = self.parents.get(id(parent))
+            if not (isinstance(gp, ast.Call) and gp.func is parent
+                    and parent.attr in _VALUE_READERS):
+                via = "other"     # method ref / non-value call / chained attr
+        self.m.accesses.append(AttrAccess(
+            attr=attr, kind=kind, node=node, locks=tuple(self.stack),
+            method=self.m.name, rmw=rmw, via=via))
+        self.generic_visit(node)
+
+
+def _analyze_method(fn, name: str, lock_attrs: set[str]) -> MethodModel:
+    parents: dict = {}
+    skip: set[int] = set()
+    for parent in ast.walk(fn):
+        if parent is not fn and isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            skip.update(id(n) for n in ast.walk(parent) if n is not parent)
+        for child in ast.iter_child_nodes(parent):
+            if id(child) not in skip:
+                parents[id(child)] = parent
+    model = MethodModel(name=name, node=fn, accesses=[], acquisitions=[],
+                        calls=[])
+    walker = _MethodWalker(model, lock_attrs, parents)
+    for stmt in fn.body:
+        walker.visit(stmt)
+    return model
+
+
+def _spawn_target(call: ast.Call) -> ast.AST | None:
+    """The callable a Thread/Timer/submit/create_task call runs, or None."""
+    f = call.func
+    tail = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if tail in _THREAD_FACTORIES:
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        if tail == "Timer" and len(call.args) > 1:
+            return call.args[1]
+        return None
+    if tail == "submit" and call.args:
+        return call.args[0]
+    if tail in _TASK_SPAWNERS and call.args:
+        # create_task(self.foo(...)) — the coroutine call's func.
+        inner = call.args[0]
+        if isinstance(inner, ast.Call):
+            return inner.func
+        return inner
+    return None
+
+
+def _analyze_class(cls: ast.ClassDef) -> ClassModel:
+    # Pass 1: lock attrs — declared factories plus anything used as a bare
+    # `with self.X:` context manager (covers locks built by a base class).
+    lock_attrs: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            tail = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if tail in _LOCK_FACTORIES:
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        lock_attrs.add(attr)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    lock_attrs.add(attr)
+
+    # Pass 2: per-method models; nested defs become pseudo-methods.
+    methods: dict[str, MethodModel] = {}
+    top: list[tuple[str, ast.AST]] = []
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            top.append((stmt.name, stmt))
+    for name, fn in top:
+        methods[name] = _analyze_method(fn, name, lock_attrs)
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pseudo = f"{name}.{node.name}"
+                methods[pseudo] = _analyze_method(node, pseudo, lock_attrs)
+
+    # Pass 3: entry points + stored threads.
+    entries: dict[str, str] = {}
+    stored: list[tuple[str, str | None, ast.AST]] = []
+    starts = False
+
+    def note_entry(method: str, why: str) -> None:
+        entries.setdefault(method, why)
+
+    for name, fn in top:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            target = _spawn_target(node)
+            if target is None:
+                continue
+            f = node.func
+            tail = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            why = {"Thread": "thread target", "Timer": "timer target",
+                   "submit": "executor submit target"}.get(
+                       tail, "async task target")
+            if tail in _THREAD_FACTORIES:
+                starts = True
+            attr = _self_attr(target)
+            if attr is not None and attr in methods:
+                note_entry(attr, why)
+            elif isinstance(target, ast.Name) \
+                    and f"{name}.{target.id}" in methods:
+                note_entry(f"{name}.{target.id}", why)
+        # self.Y = threading.Thread(...) — stored, lifecycle-checked.
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                f = node.value.func
+                tail = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                if tail not in _THREAD_FACTORIES:
+                    continue
+                tgt = _spawn_target(node.value)
+                tgt_name = _self_attr(tgt) if tgt is not None else None
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        stored.append((attr, tgt_name, node))
+
+    # A class that owns a lock or starts threads is a concurrent surface:
+    # its public methods are callable from other threads (RPC handlers on
+    # actor classes, controller API methods) and count as entry points.
+    if lock_attrs or starts:
+        for name, _fn in top:
+            if not name.startswith("_"):
+                note_entry(name, "public entry surface")
+
+    return ClassModel(node=cls, name=cls.name, lock_attrs=lock_attrs,
+                      methods=methods, entry_points=entries,
+                      stored_threads=stored, starts_threads=starts)
+
+
+def class_models(ctx) -> list[ClassModel]:
+    """Per-file memo of the per-class concurrency models (v3 rules)."""
+    if "classmodels" not in ctx.cache:
+        ctx.cache["classmodels"] = [
+            _analyze_class(node) for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.ClassDef)]
+    return ctx.cache["classmodels"]

@@ -16,6 +16,7 @@ import ast
 import dataclasses
 import hashlib
 import re
+import time
 from pathlib import Path
 from typing import Iterable
 
@@ -144,57 +145,108 @@ class LintResult:
     suppressed: int
     parse_errors: list[str]
     scanned_files: list[str] = dataclasses.field(default_factory=list)
+    # rule id → seconds spent in check() summed over files (CPU-seconds
+    # when --jobs > 1: per-worker times are added, not overlapped).
+    rule_seconds: dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def new_findings(self) -> list[Finding]:
         return [f for f in self.findings if not f.baselined]
 
 
+@dataclasses.dataclass
+class _FileResult:
+    path: str | None                 # None when the file failed to parse
+    findings: list[Finding]          # post-suppression, fingerprinted,
+    suppressed: int                  # NOT yet baseline-marked
+    parse_error: str | None
+    rule_seconds: dict[str, float]
+
+
+def _lint_one(f: Path, rules: list[Rule]) -> _FileResult:
+    path = normalize_path(f)
+    try:
+        src = f.read_text(encoding="utf-8")
+        tree = ast.parse(src, filename=path)
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        # NOT added to scanned_files: an unparseable file has unknown
+        # findings — baseline.write must not treat it as "now clean".
+        return _FileResult(None, [], 0, f"{path}: {e}", {})
+    ctx = FileContext(path, src, tree)
+    per_file: list[Finding] = []
+    timings: dict[str, float] = {}
+    for rule in rules:
+        if not rule.applies_to(path):
+            continue
+        t0 = time.perf_counter()
+        found = rule.check(ctx)
+        timings[rule.id] = timings.get(rule.id, 0.0) \
+            + time.perf_counter() - t0
+        per_file.extend(found)
+    kept: list[Finding] = []
+    suppressed = 0
+    for fd in sorted(per_file, key=lambda x: (x.line, x.col, x.rule)):
+        sup = _suppressed_rules_for_line(ctx.lines, fd.line)
+        if "ALL" in sup or fd.rule.upper() in sup:
+            suppressed += 1
+            continue
+        text = ctx.lines[fd.line - 1] if fd.line - 1 < len(ctx.lines) else ""
+        fd.fingerprint = _fingerprint(path, fd.rule, text)
+        kept.append(fd)
+    return _FileResult(path, kept, suppressed, None, timings)
+
+
+def _lint_one_star(args: tuple[str, list[Rule]]) -> _FileResult:
+    # Module-level for pickling into ProcessPoolExecutor workers.
+    return _lint_one(Path(args[0]), args[1])
+
+
 def lint_paths(
     paths: Iterable[str],
     rules: list[Rule],
     baseline_counts: dict[str, int] | None = None,
+    jobs: int = 1,
 ) -> LintResult:
     baseline_counts = baseline_counts or {}
+    files = iter_python_files(paths)
+
+    if jobs > 1 and len(files) > 1:
+        import concurrent.futures as _cf
+        work = [(str(f), rules) for f in files]
+        try:
+            with _cf.ProcessPoolExecutor(max_workers=jobs) as ex:
+                results = list(ex.map(
+                    _lint_one_star, work,
+                    chunksize=max(1, len(work) // (jobs * 4))))
+        except (OSError, _cf.process.BrokenProcessPool):
+            # Sandboxes without fork/semaphores still lint, just serially.
+            results = [_lint_one(f, rules) for f in files]
+    else:
+        results = [_lint_one(f, rules) for f in files]
+
     findings: list[Finding] = []
     suppressed = 0
     parse_errors: list[str] = []
     scanned: list[str] = []
-
-    for f in iter_python_files(paths):
-        path = normalize_path(f)
-        try:
-            src = f.read_text(encoding="utf-8")
-            tree = ast.parse(src, filename=path)
-        except (SyntaxError, UnicodeDecodeError, OSError) as e:
-            # NOT added to scanned_files: an unparseable file has unknown
-            # findings — baseline.write must not treat it as "now clean".
-            parse_errors.append(f"{path}: {e}")
+    rule_seconds: dict[str, float] = {}
+    # First `count` findings per fingerprint (file order) are tolerated;
+    # identical lines beyond the baselined count are new. Fingerprints
+    # embed the path, so per-run counting equals per-file counting.
+    used: dict[str, int] = {}
+    for res in results:
+        if res.parse_error is not None:
+            parse_errors.append(res.parse_error)
             continue
-        scanned.append(path)
-        ctx = FileContext(path, src, tree)
-        per_file: list[Finding] = []
-        for rule in rules:
-            if not rule.applies_to(path):
-                continue
-            per_file.extend(rule.check(ctx))
-        kept: list[Finding] = []
-        for fd in sorted(per_file, key=lambda x: (x.line, x.col, x.rule)):
-            sup = _suppressed_rules_for_line(ctx.lines, fd.line)
-            if "ALL" in sup or fd.rule.upper() in sup:
-                suppressed += 1
-                continue
-            kept.append(fd)
-        # First `count` findings per fingerprint (file order) are tolerated;
-        # identical lines beyond the baselined count are new.
-        used: dict[str, int] = {}
-        for fd in kept:
-            text = ctx.lines[fd.line - 1] if fd.line - 1 < len(ctx.lines) else ""
-            fd.fingerprint = _fingerprint(path, fd.rule, text)
+        scanned.append(res.path)
+        suppressed += res.suppressed
+        for rule_id, secs in res.rule_seconds.items():
+            rule_seconds[rule_id] = rule_seconds.get(rule_id, 0.0) + secs
+        for fd in res.findings:
             n = used.get(fd.fingerprint, 0)
             used[fd.fingerprint] = n + 1
             fd.baselined = n < baseline_counts.get(fd.fingerprint, 0)
-        findings.extend(kept)
+            findings.append(fd)
 
     return LintResult(findings=findings, suppressed=suppressed,
-                      parse_errors=parse_errors, scanned_files=scanned)
+                      parse_errors=parse_errors, scanned_files=scanned,
+                      rule_seconds=rule_seconds)

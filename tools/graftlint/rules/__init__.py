@@ -15,8 +15,12 @@ from tools.graftlint.rules.jit import (
     JitInLoopRule,
     JitSideEffectRule,
 )
+from tools.graftlint.rules.guardedby import GuardedByRule
+from tools.graftlint.rules.knobdrift import KnobDriftRule
+from tools.graftlint.rules.lockorder import LockOrderRule
 from tools.graftlint.rules.quant import QuantUpcastRule
 from tools.graftlint.rules.recompile import RecompileHazardRule
+from tools.graftlint.rules.respair import ResPairRule
 from tools.graftlint.rules.serialize import SerCaptureRule
 from tools.graftlint.rules.shardspec import ShardSpecRule
 
@@ -33,10 +37,15 @@ ALL_RULES = [
     ShardSpecRule(),
     JaxCompatRule(),
     QuantUpcastRule(),
+    GuardedByRule(),
+    LockOrderRule(),
+    ResPairRule(),
+    KnobDriftRule(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
 
-# v2 rule families — kept here so CI and the baseline tests can name the
-# set without enumerating it twice.
+# v2/v3 rule families — kept here so CI and the baseline tests can name
+# the sets without enumerating them twice.
 V2_FAMILIES = ("RECOMPILE-HAZARD", "SHARD-SPEC", "JAX-COMPAT")
+V3_FAMILIES = ("GUARDED-BY", "LOCK-ORDER", "RES-PAIR", "KNOB-DRIFT")

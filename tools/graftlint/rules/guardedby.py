@@ -1,0 +1,236 @@
+"""GUARDED-BY: inferred lock discipline for `self._*` state shared across
+thread entry points.
+
+Entry points per class (callgraph.class_models): thread/timer targets,
+executor submit targets, async task targets, and — on a class that owns a
+lock or starts a thread — every public method (the RPC-handler surface of
+an actor class). Each entry's reach is its own body plus ONE hop through
+same-class `self.foo()` calls (same resolution discipline as v2).
+
+The guard of an attribute is the lock most often held at its write sites
+(`with self._lock:` extent tracking, function-scoped). Three findings:
+
+(a) a write outside the inferred guard (or, for unguarded attributes,
+    writes from ≥2 distinct entry points with no common lock — but only
+    when the write is a read-modify-write or the method also VALUE-reads
+    the attribute unlocked: a lone `d[k] = v` / `s.add(x)` is GIL-atomic
+    and idiomatic here, the racy shape is the compound);
+(b) check-then-act: an `if` that reads a guarded attribute under one lock
+    context and acts on it under a different one (TOCTOU);
+(c) iteration over a guarded container outside its guard while another
+    method mutates it — the PR 11 shutdown/reconcile dict-resize race,
+    as a rule.
+
+`__init__` writes are excluded everywhere (construction happens-before
+publication). Findings are capped at one per (attribute, method, kind).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.callgraph import AttrAccess, ClassModel, class_models
+from tools.graftlint.engine import FileContext, Finding, Rule
+
+_INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+def _merge_locks(a: tuple[str, ...], b: tuple[str, ...]) -> tuple[str, ...]:
+    return tuple(dict.fromkeys(a + b))
+
+
+def entry_reach(cm: ClassModel, entry: str) -> list[AttrAccess]:
+    """Accesses an entry point reaches: own body + one hop through
+    same-class calls, with the caller's held locks folded into the
+    callee's accesses (a helper called under the lock IS under the lock).
+    """
+    m = cm.methods.get(entry)
+    if m is None:
+        return []
+    out = list(m.accesses)
+    for _site, callee, locks in m.calls:
+        if callee and callee != entry and callee in cm.methods:
+            for a in cm.methods[callee].accesses:
+                out.append(AttrAccess(
+                    attr=a.attr, kind=a.kind, node=a.node,
+                    locks=_merge_locks(locks, a.locks),
+                    method=a.method, rmw=a.rmw, via=a.via))
+    return out
+
+
+def _is_init(method: str) -> bool:
+    return method.split(".")[0] in _INIT_METHODS
+
+
+def infer_guards(cm: ClassModel) -> dict[str, str]:
+    """attr → the lock most often held at its write sites (non-__init__).
+    When NO write site is locked, fall back to the lock most often held
+    at ITERATION sites: a reader-locked/writer-unlocked attribute is
+    still guarded — the unlocked writers are the bug, not the guard."""
+    wvotes: dict[str, dict[str, int]] = {}
+    ivotes: dict[str, dict[str, int]] = {}
+    for m in cm.methods.values():
+        if _is_init(m.name):
+            continue
+        for a in m.accesses:
+            if a.kind == "write":
+                tgt = wvotes
+            elif a.kind == "iter":
+                tgt = ivotes
+            else:
+                continue
+            for lock in a.locks:
+                d = tgt.setdefault(a.attr, {})
+                d[lock] = d.get(lock, 0) + 1
+    guards = {attr: max(d, key=d.get) for attr, d in wvotes.items() if d}
+    for attr, d in ivotes.items():
+        if attr not in guards and d:
+            guards[attr] = max(d, key=d.get)
+    return guards
+
+
+class GuardedByRule(Rule):
+    id = "GUARDED-BY"
+    summary = ("self attribute shared across thread entry points written/"
+               "iterated outside its inferred lock guard (or check-then-act"
+               " across lock extents)")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for cm in class_models(ctx):
+            if not cm.entry_points:
+                continue
+            out.extend(self._check_class(ctx, cm))
+        return out
+
+    # ------------------------------------------------------------ class
+
+    def _check_class(self, ctx: FileContext, cm: ClassModel) -> list[Finding]:
+        out: list[Finding] = []
+        guards = infer_guards(cm)
+
+        # Entry → reach set; attr → entries touching / writing it.
+        reach = {e: entry_reach(cm, e) for e in cm.entry_points}
+        touched: dict[str, set[str]] = {}
+        writers: dict[str, set[str]] = {}
+        for e, accesses in reach.items():
+            for a in accesses:
+                if a.attr in cm.lock_attrs or _is_init(a.method):
+                    continue
+                touched.setdefault(a.attr, set()).add(e)
+                if a.kind == "write":
+                    writers.setdefault(a.attr, set()).add(e)
+
+        # Attrs VALUE-read with no lock held, per raw method body — the
+        # compound signal separating a racy read-modify-write from a
+        # GIL-atomic single dict/set op (variant a, unguarded branch).
+        unlocked_value_reads: dict[str, set[str]] = {}
+        for m in cm.methods.values():
+            if _is_init(m.name):
+                continue
+            for a in m.accesses:
+                if a.kind in ("read", "iter") and a.via == "value" \
+                        and not a.locks:
+                    unlocked_value_reads.setdefault(m.name, set()).add(a.attr)
+
+        # Any write to the attr anywhere in the class (for variant c).
+        all_writes: dict[str, list[AttrAccess]] = {}
+        for m in cm.methods.values():
+            if _is_init(m.name):
+                continue
+            for a in m.accesses:
+                if a.kind == "write":
+                    all_writes.setdefault(a.attr, []).append(a)
+
+        seen: set[tuple] = set()
+
+        def emit(key: tuple, node: ast.AST, msg: str) -> None:
+            if key in seen:
+                return
+            seen.add(key)
+            out.append(ctx.finding(self.id, node, msg))
+
+        # (a) writes outside the guard / no common guard across entries.
+        for e, accesses in reach.items():
+            for a in accesses:
+                if a.kind != "write" or a.attr in cm.lock_attrs \
+                        or _is_init(a.method):
+                    continue
+                guard = guards.get(a.attr)
+                if guard is not None:
+                    if guard in a.locks or len(touched.get(a.attr, ())) < 2:
+                        continue
+                    emit(("a", a.attr, a.method), a.node,
+                         f"`{cm.name}.{a.attr}` is guarded by "
+                         f"`self.{guard}` at its other write sites, but "
+                         f"`{a.method}` (reachable from entry point "
+                         f"`{e}`) writes it without the lock — wrap the "
+                         f"write in `with self.{guard}:`")
+                else:
+                    if a.locks or len(writers.get(a.attr, ())) < 2:
+                        continue
+                    if not a.rmw and a.attr not in \
+                            unlocked_value_reads.get(a.method, ()):
+                        continue   # lone GIL-atomic op, no compound
+                    ents = sorted(writers[a.attr])
+                    emit(("a", a.attr, a.method), a.node,
+                         f"`{cm.name}.{a.attr}` is written from "
+                         f"{len(ents)} entry points "
+                         f"({', '.join(ents[:3])}) with no common lock — "
+                         "concurrent writes race; pick a lock and hold "
+                         "it at every write site")
+
+        # (b) check-then-act across lock extents, same method.
+        for m in cm.methods.values():
+            if _is_init(m.name):
+                continue
+            for node in ast.walk(m.node):
+                if not isinstance(node, ast.If):
+                    continue
+                test_ids = {id(n) for n in ast.walk(node.test)}
+                body_ids = set()
+                for stmt in node.body + node.orelse:
+                    body_ids.update(id(n) for n in ast.walk(stmt))
+                tests = {a.attr: a for a in m.accesses
+                         if id(a.node) in test_ids and a.kind == "read"}
+                for a in m.accesses:
+                    if id(a.node) not in body_ids or a.kind != "write":
+                        continue
+                    t = tests.get(a.attr)
+                    if t is None or a.attr in cm.lock_attrs:
+                        continue
+                    guard = guards.get(a.attr)
+                    if guard is None or len(touched.get(a.attr, ())) < 2:
+                        continue
+                    if t.locks == a.locks:
+                        continue   # same extent: check and act are atomic
+                    emit(("b", a.attr, m.name), t.node,
+                         f"check-then-act on `{cm.name}.{a.attr}`: the "
+                         f"check at line {t.node.lineno} and the act at "
+                         f"line {a.node.lineno} run under different lock "
+                         f"extents (guard is `self.{guard}`) — another "
+                         "thread can interleave between them; hold the "
+                         "lock across both")
+
+        # (c) iteration outside the guard while another method mutates.
+        for e, accesses in reach.items():
+            for a in accesses:
+                if a.kind != "iter" or a.attr in cm.lock_attrs \
+                        or _is_init(a.method):
+                    continue
+                guard = guards.get(a.attr)
+                if guard is None or guard in a.locks:
+                    continue
+                if len(touched.get(a.attr, ())) < 2:
+                    continue
+                others = [w for w in all_writes.get(a.attr, ())
+                          if w.method != a.method]
+                if not others:
+                    continue
+                emit(("c", a.attr, a.method), a.node,
+                     f"`{a.method}` iterates `{cm.name}.{a.attr}` outside "
+                     f"its guard `self.{guard}` while `{others[0].method}` "
+                     "mutates it — a concurrent resize corrupts the "
+                     "iteration (the PR 11 shutdown race); snapshot under "
+                     "the lock first")
+        return out

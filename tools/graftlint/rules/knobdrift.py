@@ -1,0 +1,186 @@
+"""KNOB-DRIFT: config-knob / env-var spelling drift.
+
+`ray_tpu/core/config.py` derives every knob's env override as
+`RAY_TPU_<FIELD.upper()>`. The llm_prefill_chunk plumbing pattern is now
+~20 knobs deep, and two kinds of drift are silent: an `os.environ` read
+of a `RAY_TPU_*` name that matches NO knob (typo'd override, dead env
+plumbing), and a doc comment in config.py naming an env spelling that no
+field backs. This rule parses the Config dataclass lazily (constructor-
+injectable path, like JaxCompatRule's version injection) and checks:
+
+1. every env read/write of a `"RAY_TPU_*"` string literal anywhere in
+   the tree resolves to a knob field, a constant declared in config.py,
+   or the infra-env table below;
+2. in the config module itself, every `RAY_TPU_[A-Z0-9_]+` token in a
+   comment resolves the same way (`Env: RAY_TPU_X=...` docs drift too).
+
+Placeholders like `RAY_TPU_<UPPERCASE_KNOB>` are naturally exempt — the
+token regex stops at `<` and empty suffixes are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from pathlib import Path
+
+from tools.graftlint.engine import REPO_ROOT, FileContext, Finding, Rule
+from tools.graftlint.rules._shared import dotted
+
+DEFAULT_CONFIG = REPO_ROOT / "ray_tpu" / "core" / "config.py"
+
+# Process/bootstrap env names owned by the runtime, not the Config
+# dataclass — addresses, session plumbing, debug toggles. Declared here
+# the same way jax_compat.py declares its symbol table.
+INFRA_ENV = frozenset((
+    "RAY_TPU_ADDRESS",
+    "RAY_TPU_GCS_ADDRESS",
+    "RAY_TPU_RAYLET_ADDRESS",
+    "RAY_TPU_SESSION_DIR",
+    "RAY_TPU_WORKER_ID",
+    "RAY_TPU_DEBUG_ACTOR_PUSH",
+    # Security opt-in, not a tunable: rpdb binds its pdb socket to a
+    # routable IP only under this flag (ref --ray-debugger-external).
+    "RAY_TPU_DEBUGGER_EXTERNAL",
+    "RAY_TPU_XLA_COLLECTIVE_TIMEOUT_FLAG",
+    "RAY_TPU_WORKFLOW_DIR",
+    "RAY_TPU_PIP_ENV_CACHE",
+))
+
+_TOKEN_RE = re.compile(r"RAY_TPU_[A-Z0-9_]+")
+_ENV_READERS = {"get", "pop", "setdefault"}
+
+
+class KnobDriftRule(Rule):
+    id = "KNOB-DRIFT"
+    summary = ("env read of a RAY_TPU_* name with no matching config "
+               "knob, or a config.py env spelling no field backs")
+
+    def __init__(self, config_path: str | Path | None = None,
+                 infra_env: frozenset[str] = INFRA_ENV):
+        self._config_path = Path(config_path or DEFAULT_CONFIG)
+        self._infra = infra_env
+        self._loaded: tuple[str, set[str], set[str]] | None = None
+
+    # -------------------------------------------------------- knob table
+
+    def _table(self) -> tuple[str, set[str], set[str]]:
+        """(env prefix, knob field names, env names declared as module
+        constants in config.py). Unreadable config → empty table, every
+        env name resolves via the prefix-only path and the rule stays
+        quiet rather than spraying false drift."""
+        if self._loaded is not None:
+            return self._loaded
+        prefix, fields, declared = "RAY_TPU_", set(), set()
+        try:
+            tree = ast.parse(self._config_path.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            self._loaded = (prefix, fields, declared)
+            return self._loaded
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        if t.id == "_ENV_PREFIX":
+                            prefix = node.value.value
+                        elif node.value.value.startswith("RAY_TPU_"):
+                            declared.add(node.value.value)
+            elif isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and isinstance(stmt.target, ast.Name):
+                        fields.add(stmt.target.id)
+        self._loaded = (prefix, fields, declared)
+        return self._loaded
+
+    def _resolves(self, env_name: str) -> bool:
+        prefix, fields, declared = self._table()
+        if env_name in declared or env_name in self._infra:
+            return True
+        if not env_name.startswith(prefix):
+            return True            # not a knob namespace: out of scope
+        suffix = env_name[len(prefix):]
+        if not suffix:
+            return True            # bare prefix: a placeholder, not a name
+        if not fields:
+            return True            # no table (unreadable config): quiet
+        return suffix.lower() in fields
+
+    # ------------------------------------------------------------ check
+
+    def _env_name_nodes(self, tree: ast.AST):
+        """(Constant node, env name) for every env read/write site."""
+        for node in ast.walk(tree):
+            target = None
+            if isinstance(node, ast.Subscript):
+                if dotted(node.value) in ("os.environ", "environ"):
+                    target = node.slice
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d in ("os.getenv", "getenv"):
+                    target = node.args[0] if node.args else None
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in (_ENV_READERS | {"setenv"}) \
+                        and dotted(node.func.value) in ("os.environ",
+                                                        "environ",
+                                                        "monkeypatch"):
+                    target = node.args[0] if node.args else None
+            if isinstance(target, ast.Constant) \
+                    and isinstance(target.value, str):
+                yield target, target.value
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        prefix, _fields, _declared = self._table()
+        for node, env_name in self._env_name_nodes(ctx.tree):
+            if not self._resolves(env_name):
+                out.append(ctx.finding(
+                    self.id, node,
+                    f"env name `{env_name}` matches no config knob "
+                    f"(expected `{prefix}<UPPERCASE_KNOB>` for a Config "
+                    "field), no declared constant, and no infra env — "
+                    "typo'd override or dead plumbing"))
+        if self._is_config_file(ctx.path):
+            out.extend(self._check_comments(ctx, prefix))
+        return out
+
+    def _is_config_file(self, path: str) -> bool:
+        p = Path(path)
+        cand = p if p.is_absolute() else REPO_ROOT / p
+        try:
+            return cand.resolve() == self._config_path.resolve()
+        except OSError:
+            return False
+
+    def _check_comments(self, ctx: FileContext, prefix: str
+                        ) -> list[Finding]:
+        out: list[Finding] = []
+        seen: set[tuple[int, str]] = set()
+        try:
+            toks = list(tokenize.generate_tokens(
+                io.StringIO(ctx.src).readline))
+        except (tokenize.TokenError, IndentationError):
+            return []
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            for env_name in _TOKEN_RE.findall(tok.string):
+                if self._resolves(env_name):
+                    continue
+                key = (tok.start[0], env_name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                fake = ast.Constant(value=env_name)
+                fake.lineno, fake.col_offset = tok.start
+                out.append(ctx.finding(
+                    self.id, fake,
+                    f"comment documents `{env_name}` but no Config field "
+                    f"spells that way (`{prefix}<UPPERCASE_KNOB>`) — the "
+                    "documented override is dead; fix the comment or add "
+                    "the knob"))
+        return out

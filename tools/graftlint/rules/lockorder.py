@@ -1,0 +1,162 @@
+"""LOCK-ORDER: per-class lock-acquisition ordering + blocking-under-lock.
+
+Builds the per-class lock graph from `with self.X:` extents: an edge
+A → B when B is acquired lexically inside A's extent, plus ONE hop —
+while holding A, a call to a same-class method whose body acquires B.
+A cycle in that graph is a potential deadlock (two threads entering the
+cycle from different edges).
+
+Second check: calls that can block for unbounded/long time while a lock
+is held — `time.sleep`, `ray_tpu.get`/`ray_tpu.wait`, zero-arg
+`.result()` / `.join()` / `.get()` (futures, threads, queues; `sep.join`
+always has an argument, `dict.get` always has one, so zero-arg forms
+disambiguate), and KV/GCS RPC sends. Every other thread contending on
+that lock stalls for the full wait — the drain/reconcile/checkpoint
+near-misses the reviews individually hardened, as a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.callgraph import ClassModel, class_models
+from tools.graftlint.engine import FileContext, Finding, Rule
+from tools.graftlint.rules._shared import dotted
+
+# Trailing-attr RPC sends that hit the GCS / object store synchronously.
+_RPC_SENDS = {"kv_put", "kv_get", "kv_del", "kv_keys", "emit_cluster_event"}
+
+
+def blocking_reason(call: ast.Call) -> str | None:
+    """Why `call` can block the calling thread, or None."""
+    d = dotted(call.func)
+    if d == "time.sleep":
+        a = call.args[0] if call.args else None
+        if isinstance(a, ast.Constant) and not a.value:
+            return None           # sleep(0) is a yield, not a wait
+        return "time.sleep(...)"
+    if d in ("ray_tpu.get", "ray_tpu.wait", "ray.get", "ray.wait"):
+        return f"{d}(...)"
+    if isinstance(call.func, ast.Attribute):
+        a = call.func.attr
+        if a in ("result", "get") and not call.args and not call.keywords:
+            return f".{a}()"      # future.result() / queue.get(), unbounded
+        if a == "join" and not call.args and not call.keywords:
+            return ".join()"      # thread.join(), unbounded
+        if a in _RPC_SENDS:
+            return f".{a}() RPC"
+    return None
+
+
+class LockOrderRule(Rule):
+    id = "LOCK-ORDER"
+    summary = ("lock-acquisition cycle across `with self.X:` extents "
+               "(deadlock) or a blocking call made while holding a lock")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for cm in class_models(ctx):
+            if not cm.lock_attrs:
+                continue
+            out.extend(self._cycles(ctx, cm))
+            out.extend(self._blocking(ctx, cm))
+        return out
+
+    # ----------------------------------------------------------- cycles
+
+    def _cycles(self, ctx: FileContext, cm: ClassModel) -> list[Finding]:
+        # edges[(A, B)] = acquisition site of B while A held.
+        edges: dict[tuple[str, str], ast.AST] = {}
+        for m in cm.methods.values():
+            for lock, held, site in m.acquisitions:
+                for h in held:
+                    if h != lock:
+                        edges.setdefault((h, lock), site)
+            # One hop: holding A, call self.foo() whose body acquires B.
+            for call, callee, held in m.calls:
+                if not held or not callee or callee not in cm.methods:
+                    continue
+                for lock, _inner_held, _site in \
+                        cm.methods[callee].acquisitions:
+                    for h in held:
+                        if h != lock:
+                            edges.setdefault((h, lock), call)
+        if not edges:
+            return []
+        graph: dict[str, set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+
+        out: list[Finding] = []
+        reported: set[frozenset] = set()
+
+        def dfs(node: str, stack: list[str], on_stack: set[str]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in on_stack:
+                    cycle = stack[stack.index(nxt):] + [nxt]
+                    key = frozenset(cycle)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    site = edges.get((node, nxt))
+                    path = " → ".join(f"self.{x}" for x in cycle)
+                    out.append(ctx.finding(
+                        self.id, site,
+                        f"lock-order cycle in `{cm.name}`: {path} — two "
+                        "threads entering from different edges deadlock; "
+                        "impose one global acquisition order"))
+                elif nxt not in visited:
+                    visited.add(nxt)
+                    dfs(nxt, stack + [nxt], on_stack | {nxt})
+
+        visited: set[str] = set()
+        for start in sorted(graph):
+            if start not in visited:
+                visited.add(start)
+                dfs(start, [start], {start})
+        return out
+
+    # --------------------------------------------------------- blocking
+
+    def _blocking(self, ctx: FileContext, cm: ClassModel) -> list[Finding]:
+        out: list[Finding] = []
+        seen: set[tuple] = set()
+        # Blocking calls directly in each method body (for the one-hop).
+        direct: dict[str, list[tuple[ast.Call, str]]] = {}
+        for m in cm.methods.values():
+            direct[m.name] = [
+                (call, reason) for call, _callee, _held in m.calls
+                if (reason := blocking_reason(call)) is not None]
+        for m in cm.methods.values():
+            for call, callee, held in m.calls:
+                if not held:
+                    continue
+                reason = blocking_reason(call)
+                if reason is not None:
+                    key = (m.name, call.lineno, call.col_offset)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(ctx.finding(
+                            self.id, call,
+                            f"`{reason}` while holding `self.{held[-1]}` "
+                            f"in `{cm.name}.{m.name}` — every thread "
+                            "contending on the lock stalls for the full "
+                            "wait; move the blocking call outside the "
+                            "extent"))
+                    continue
+                if callee and callee != m.name and callee in cm.methods:
+                    for bcall, breason in direct.get(callee, ()):
+                        key = (m.name, call.lineno, callee, breason)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        out.append(ctx.finding(
+                            self.id, call,
+                            f"`{callee}` does `{breason}` (line "
+                            f"{bcall.lineno}) and is called while "
+                            f"`{cm.name}.{m.name}` holds "
+                            f"`self.{held[-1]}` — a blocking call one "
+                            "hop under the lock; move it outside the "
+                            "extent"))
+                        break
+        return out
