@@ -306,8 +306,33 @@ def main() -> None:
                          "PREFILL replica inside a donation (the "
                          "donor-death scenario) instead of a decode "
                          "window.")
+    ap.add_argument("--fleet-warm", action="store_true",
+                    help="Fleet-wide warm-hit model (round 16): two "
+                         "in-process engines sharing one page-set "
+                         "store. The donor serves a prompt set (every "
+                         "completion donates its written prefix); its "
+                         "exported kv_summary is handed to a "
+                         "DeploymentHandle exactly as the routing push "
+                         "would, and the ADOPTER — which never saw any "
+                         "of those prompts — serves them again with "
+                         "only the handle's discover hint. Emits cold "
+                         "vs warm TTFT on the adopter plus the "
+                         "request-path digest-lookup counters.")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
+    if args.fleet_warm:
+        if args.kv_mode != "paged" or not args.prefill_chunk:
+            ap.error("--fleet-warm requires --kv-mode paged and "
+                     "--prefill-chunk > 0 (page-set donation is keyed "
+                     "at chunk depth)")
+        if (args.real_replicas or args.ramp or args.spec_draft
+                or args.pool_split or args.repeat_period
+                or args.prefix_cache):
+            ap.error("--fleet-warm is the in-process two-engine model; "
+                     "it cannot combine with --real-replicas/--ramp/"
+                     "--spec-draft/--pool-split/--repeat-period/"
+                     "--prefix-cache (cross-replica adoption is the "
+                     "measured effect, local caching would mask it)")
     pool_split = None
     if args.pool_split:
         try:
@@ -403,6 +428,10 @@ def main() -> None:
         from ray_tpu.utils.platform import force_cpu_devices
 
         force_cpu_devices(max(1, args.tp))
+
+    if args.fleet_warm:
+        _run_fleet_warm(args)
+        return
 
     from ray_tpu.models import gpt
     from ray_tpu.serve.llm import LLMEngine
@@ -786,6 +815,151 @@ def main() -> None:
         row["spec_proposed"] = em.get("spec_proposed", 0)
         row["spec_accepted"] = em.get("spec_accepted", 0)
         row["spec_verify_ticks"] = em.get("spec_ticks", 0)
+    print(json.dumps(row), flush=True)
+    if args.json_out:
+        json.dump(row, open(args.json_out, "w"))
+
+
+def _run_fleet_warm(args) -> None:
+    """Fleet-wide warm-hit model (round 16): the cluster KV tier's
+    headline, reproducible off-TPU with two in-process engines.
+
+    The donor serves a prompt set; every completion donates its written
+    prefix to the SHARED page-set store (insert-on-free). The donor's
+    exported ``kv_summary`` is then handed to a real DeploymentHandle
+    exactly as the routing push would ship it, and the ADOPTER — a
+    replica that never saw any of those prompts — serves the same set
+    with only the handle's ``kv={"discover": True}`` hint. Cold TTFT is
+    the adopter on prompts nobody donated. The committed evidence:
+    warm p50 under cold p50, ``kv_digest_lookups_cold == 0`` (unhinted
+    admissions never poll the index — discovery rode the push, not the
+    request path), ``kv_digest_lookups_warm == kv_adoptions`` (one
+    authorized resolve per adopting admission), and
+    ``jax_compiles_delta == 0``."""
+    import jax
+
+    from ray_tpu import compile_watch
+    from ray_tpu.models import gpt
+    from ray_tpu.serve.api import DeploymentHandle
+    from ray_tpu.serve.kv_objects import LocalKVStore
+    from ray_tpu.serve.llm import LLMEngine
+
+    cfg = gpt.GPTConfig.by_name(args.model)
+    params = gpt.init_params(cfg, jax.random.key(0))
+    store = LocalKVStore(budget=4096)
+
+    def mk_engine():
+        return LLMEngine(cfg, params, n_slots=args.n_slots,
+                         max_len=args.max_len,
+                         decode_block=args.decode_block,
+                         kv_mode="paged", page_size=args.page_size,
+                         n_pages=args.n_pages, attn_impl=args.attn_impl,
+                         prefill_chunk=args.prefill_chunk,
+                         prefill_token_budget=args.prefill_budget,
+                         tp=args.tp, weight_dtype=args.weight_dtype,
+                         kv_dtype=args.kv_dtype,
+                         kv_transfer=True, kv_store=store,
+                         prefill_width_bucketing=args.width_bucketing)
+
+    rng = np.random.default_rng(0)
+
+    def mk_prompt():
+        return list(map(int,
+                        rng.integers(0, cfg.vocab_size, args.prompt_len)))
+
+    warm_set = [mk_prompt() for _ in range(args.requests)]
+    cold_set = [mk_prompt() for _ in range(args.requests)]
+    prewarm = mk_prompt()
+
+    donor, adopter = mk_engine(), mk_engine()
+
+    def drive(eng, reqs):
+        while not all(r.done.is_set() for r in reqs):
+            eng.step()
+        bad = [r.error for r in reqs if r.error]
+        if bad:
+            raise SystemExit(f"fleet-warm request failed: {bad[0]}")
+        return reqs
+
+    # Warmup: the bucket ladder on both engines, then one donation →
+    # adoption round trip on a throwaway prompt so the gather/scatter
+    # page-set programs (pow-2 widths) are compiled before the measured
+    # window — exactly the discipline of the main bench path.
+    for eng in (donor, adopter):
+        eng.warmup_compile()
+    drive(donor, [donor.submit(prewarm, max_tokens=args.max_tokens)])
+    drive(adopter, [adopter.submit(prewarm, max_tokens=args.max_tokens,
+                                   kv={"discover": True})])
+    for burst in (8, 4, 2):
+        if burst <= args.n_slots:
+            drive(adopter, [adopter.submit(mk_prompt(), max_tokens=2)
+                            for _ in range(burst)])
+    for eng in (donor, adopter):
+        eng.reset_stats()
+    compiles0 = compile_watch.compiles_total()
+
+    def serve_ttfts(eng, prompts, kvs=None):
+        reqs = [eng.submit(p, max_tokens=args.max_tokens,
+                           kv=(kvs[i] if kvs else None))
+                for i, p in enumerate(prompts)]
+        drive(eng, reqs)
+        return sorted(r.first_token_at - r.submitted_at for r in reqs)
+
+    # Cold phase: the adopter serves prompts NOBODY donated — and must
+    # never poll the index for them (no hint, no lookup).
+    cold = serve_ttfts(adopter, cold_set)
+    lookups_cold = adopter.metrics()["kv_digest_lookups"]
+
+    # Donor phase: completions donate insert-on-free; the summary this
+    # engine exports via load_snapshot() is what the probe ships.
+    serve_ttfts(donor, warm_set)
+    summary = donor.load_snapshot()["kv_summary"]
+
+    # The "routing push": a real handle, fed the pushed summary union,
+    # attaches the discover hint — the same kv_hint every routed
+    # request crosses. No cluster, no RPCs: the table is local.
+    handle = DeploymentHandle("fleet-warm-bench")
+    handle._kv_warm = frozenset(summary)
+    handle._affinity_chunk = args.prefill_chunk
+    hinted = [handle.kv_hint({"prompt_ids": p}) for p in warm_set]
+    kvs = [h.get("kv") for h in hinted]
+
+    # Warm phase: the adopter has NEVER seen these prompts — adoption
+    # via the pushed summary + hint alone.
+    warm = serve_ttfts(adopter, warm_set, kvs)
+    am = adopter.metrics()
+    lookups_warm = am["kv_digest_lookups"] - lookups_cold
+
+    row = {
+        "metric": "serve_llm_fleet_warm",
+        "model": args.model,
+        "kv_mode": "paged",
+        "requests_per_phase": args.requests,
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "prefill_chunk": args.prefill_chunk,
+        "page_size": args.page_size,
+        "n_slots": args.n_slots,
+        "llm_tp": args.tp,
+        "llm_kv_dtype": adopter.kv_dtype,
+        "ttft_cold_p50_ms": round(cold[len(cold) // 2] * 1000, 1),
+        "ttft_cold_p95_ms": round(cold[int(len(cold) * 0.95)] * 1000, 1),
+        "ttft_warm_p50_ms": round(warm[len(warm) // 2] * 1000, 1),
+        "ttft_warm_p95_ms": round(warm[int(len(warm) * 0.95)] * 1000, 1),
+        "warm_hinted": sum(1 for kv in kvs if kv),
+        "kv_adoptions": am["kv_adoptions"],
+        "kv_adopt_failures": am["kv_adopt_failures"],
+        "kv_adopted_tokens": am["kv_adopted_tokens"],
+        "kv_digest_lookups_cold": lookups_cold,
+        "kv_digest_lookups_warm": lookups_warm,
+        "kv_summary_entries": len(summary),
+        # The per-replica push payload this summary costs (satellite:
+        # serve_routes_push_bytes measures the live cluster's total).
+        "kv_summary_bytes": len(json.dumps(summary)),
+        "store_entries": store.stats()["entries"],
+        "jax_compiles_delta": int(
+            compile_watch.compiles_total() - compiles0),
+    }
     print(json.dumps(row), flush=True)
     if args.json_out:
         json.dump(row, open(args.json_out, "w"))

@@ -419,11 +419,14 @@ class Config:
     # resolvable page sets by reference instead of re-prefilling
     # (failover ladder: adopt → partial-adopt + cold-suffix prefill →
     # teacher-forced re-prefill). Requires kv_mode="paged" AND
-    # llm_prefill_chunk > 0 AND llm_tp == 1; on any misfit the GLOBAL
-    # knob soft-disables (a fleet-wide export must not crash replica
-    # boot) while explicit constructor args raise typed errors, like
-    # llm_prefill_chunk. Forced on by pool_role (disaggregated
-    # prefill/decode pools — the handoff IS a donation + adoption).
+    # llm_prefill_chunk > 0 (page-aligned chunks); llm_tp > 1 engines
+    # donate per-shard head planes and adopters reshard at bind time
+    # (partition.split_head_planes/concat_head_planes), so tp composes.
+    # On any misfit the GLOBAL knob soft-disables (a fleet-wide export
+    # must not crash replica boot) while explicit constructor args raise
+    # typed errors, like llm_prefill_chunk. Forced on by pool_role
+    # (disaggregated prefill/decode pools — the handoff IS a donation +
+    # adoption).
     llm_kv_transfer: bool = False
     # Max page-set entries one donor engine keeps alive (oldest
     # donations are withdrawn first — their objects freed and index
@@ -437,6 +440,13 @@ class Config:
     # Cadence of the controller-side orphan sweep (full reconcile
     # passes only).
     serve_kv_sweep_interval_s: float = 10.0
+    # Hard cap on the per-replica donated-chain-head summary that rides
+    # load_snapshot() → the controller's routing push (descriptor-less
+    # warm discovery): at most this many chain heads per replica, newest
+    # kept — an oversized summary degrades to truncation, never an
+    # unbounded push (the 100-replica control-plane soak bound). Also
+    # bounds the engine-side donation memo the summary is read from.
+    serve_kv_summary_max: int = 128
 
     # --- flight recorder (compile watch + SLO monitor) ---
     # Recompile-storm alarm (ray_tpu/compile_watch.py): a structured

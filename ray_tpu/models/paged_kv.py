@@ -862,6 +862,39 @@ def copy_pages_tp(pool, src, dst, *, mesh):
     return _smap(body, mesh, (kvspecs, rep, rep), kvspecs)(pool, src, dst)
 
 
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def gather_pages_tp(pool, pages, *, mesh):
+    """`gather_pages` over a tp mesh: each shard reads its own head
+    slice of the requested pages; the output rides the pool's sharded
+    specs, so a host-side ``np.asarray`` on the result reassembles the
+    FULL-head page planes — the donation path stays tp-invariant at the
+    payload level and the per-shard split happens on host (see
+    partition.split_head_planes)."""
+    _, kvspecs, rep = _tp_specs({}, pool)
+
+    def body(pool, pages):
+        return {k: v[:, pages] for k, v in pool.items()}
+
+    return _smap(body, mesh, (kvspecs, rep), kvspecs)(pool, pages)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
+def scatter_pages_tp(pool, pages, payload, *, mesh):
+    """`scatter_pages` over a tp mesh: the full-head payload shards
+    along the same head-axis specs as the pool, so each shard writes
+    exactly its head slice — an adopter at ANY tp degree re-slices a
+    donated full-head payload per its own mesh at bind time (the
+    resharding-adoption contract). Padding convention matches the
+    single-shard twin (null-page ids + zero payloads)."""
+    _, kvspecs, rep = _tp_specs({}, pool)
+
+    def body(pool, pages, payload):
+        return {k: pool[k].at[:, pages].set(payload[k]) for k in pool}
+
+    return _smap(body, mesh, (kvspecs, rep, kvspecs), kvspecs)(
+        pool, pages, payload)
+
+
 @functools.partial(jax.jit, static_argnums=(0,),
                    static_argnames=("k", "attn_impl", "need_probs", "mesh"),
                    donate_argnums=(3,))
@@ -907,4 +940,5 @@ __all__ = [
     "KV_POOL_PARTITION_RULES", "prefill_chunk_paged_tp",
     "verify_chunk_paged_tp", "decode_step_paged_tp",
     "decode_multi_paged_tp", "copy_pages_tp", "spec_draft_propose_tp",
+    "gather_pages_tp", "scatter_pages_tp",
 ]

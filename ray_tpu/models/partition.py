@@ -38,7 +38,72 @@ __all__ = [
     "PartitionRuleError", "match_partition_rules", "make_tp_mesh",
     "shard_by_rules", "tree_path_names", "logical_to_spec",
     "tree_to_shardings", "shard_tree", "TP_AXIS",
+    "split_head_planes", "concat_head_planes",
 ]
+
+# KV page planes [L, n_pages, page_size, H, K] shard on their head dim —
+# the axis the ("tp",) mesh partitions (paged_kv.KV_POOL_PARTITION_RULES).
+# split_head_planes/concat_head_planes below speak the same axis.
+KV_HEAD_AXIS = 3
+
+
+def split_head_planes(payload: dict, tp: int) -> dict:
+    """Full-head host page planes → per-shard planes keyed ``name@s``.
+
+    The KV page-set donation path at ``llm_tp > 1``: a gathered payload
+    ``{"k": [L, n, ps, H, K], ...}`` splits along the head axis into
+    ``tp`` planes (``k@0`` … ``k@{tp-1}``), so each entry in the object
+    store is one shard's bytes and an adopter reassembles exactly the
+    shards it needs. ``_scale``-suffixed planes ([L, n] per-page
+    scalars) are replicated across head shards by construction
+    (`paged_kv._quant_write` pmax's them), so ONE copy rides unsuffixed.
+    ``tp == 1`` is the identity (the unsharded wire schema of tp=1
+    donors is unchanged)."""
+    if tp <= 1:
+        return dict(payload)
+    out: dict = {}
+    for name, arr in payload.items():
+        if name.endswith("_scale") or getattr(arr, "ndim", 0) <= KV_HEAD_AXIS:
+            out[name] = arr
+            continue
+        h = arr.shape[KV_HEAD_AXIS]
+        if h % tp:
+            raise ValueError(
+                f"cannot split plane {name!r}: head dim {h} not divisible "
+                f"by tp={tp}")
+        for s, piece in enumerate(np.split(arr, tp, axis=KV_HEAD_AXIS)):
+            out[f"{name}@{s}"] = piece
+    return out
+
+
+def concat_head_planes(payload: dict, tp: int) -> dict:
+    """Inverse of `split_head_planes`: ``name@s`` shard planes →
+    full-head planes (head-axis concatenation in shard order).
+
+    The adoption path: heads are shard-invariant math, so an adopter at
+    a DIFFERENT tp degree first reassembles the donor's full-head plane
+    here, then its own (possibly shard_map-rebound) scatter re-slices
+    per its mesh — tp=2 donor → tp=4 adopter and the reverse both fall
+    out of the same two steps. Raises if a shard plane is missing (a
+    torn donation must fail the adopt rung, not bind garbage heads)."""
+    if tp <= 1:
+        return dict(payload)
+    out: dict = {}
+    shards: dict[str, dict[int, Any]] = {}
+    for name, arr in payload.items():
+        base, sep, idx = name.rpartition("@")
+        if sep and idx.isdigit():
+            shards.setdefault(base, {})[int(idx)] = arr
+        else:
+            out[name] = arr
+    for base, pieces in shards.items():
+        if sorted(pieces) != list(range(tp)):
+            raise ValueError(
+                f"sharded payload plane {base!r} is torn: have shards "
+                f"{sorted(pieces)}, want 0..{tp - 1}")
+        out[base] = np.concatenate(
+            [pieces[s] for s in range(tp)], axis=KV_HEAD_AXIS)
+    return out
 
 # The serving tensor-parallel mesh axis. Rule tables that shard over it
 # (gpt.partition_rules, paged_kv.KV_POOL_PARTITION_RULES) name it via

@@ -281,6 +281,14 @@ class DeploymentHandle:
         # local wall-clock diff).
         self._loads_ref: tuple[float, float] | None = None
         self._overload_pinned = False
+        # Descriptor-less warm discovery (pushed with the load table):
+        # actor id hex → the replica's donated-chain-head summary
+        # (16-hex depth-1 digest prefixes — the affinity-key space),
+        # and the fleet-wide union for the O(1) "is this prefix warm
+        # ANYWHERE" hint check. Refreshed with every routing push, so
+        # neither costs a request-path RPC.
+        self._kv_summaries: dict[str, frozenset] = {}
+        self._kv_warm: frozenset = frozenset()
         self._lock = threading.Lock()
         self._last_refresh = 0.0
         # Router-local in-flight per replica (actor id → count): the
@@ -318,6 +326,13 @@ class DeploymentHandle:
             route = table["routes"].get(self.deployment_name)
             self._replicas = route["replicas"] if route else []
             self._loads = (route.get("loads") or {}) if route else {}
+            summaries = {
+                aid: frozenset(row.get("kv_summary") or ())
+                for aid, row in self._loads.items()
+                if row.get("kv_summary")}
+            self._kv_summaries = summaries
+            self._kv_warm = (frozenset().union(*summaries.values())
+                             if summaries else frozenset())
             tbl_ts = table.get("ts")
             self._loads_ref = (None if tbl_ts is None
                                else (float(tbl_ts), time.monotonic()))
@@ -452,6 +467,26 @@ class DeploymentHandle:
             return replicas[0]
         if affinity_key is not None and self._policy == "affinity":
             pref = _rendezvous(affinity_key, replicas)
+            head = affinity_key.hex()[:16]
+            with self._lock:
+                summaries = self._kv_summaries
+            if summaries and head not in summaries.get(
+                    pref._actor_id.hex(), ()):
+                # Pushed-summary override: the rendezvous pick never
+                # donated this chain, but another replica advertises it
+                # — route to the least-loaded holder (its pages adopt
+                # or its cache is warm either way), under the SAME
+                # spill threshold so a hot holder never beats load
+                # balancing. A stale summary just sends the request
+                # somewhere it re-prefills — the ladder's fallback rung
+                # keeps it correct.
+                holders = [r for r in replicas
+                           if head in summaries.get(
+                               r._actor_id.hex(), ())]
+                if holders:
+                    best = min(holders, key=self._blended)
+                    if self._blended(best) < self._spill_ongoing:
+                        return best
             if self._blended(pref) < self._spill_ongoing:
                 return pref
             # Preferred replica is hot: spill to the load-balanced pick.
@@ -495,6 +530,40 @@ class DeploymentHandle:
             # Unhashable payload (wrong dtype/shape): route by load.
             logger.debug("affinity key failed (routing by load): %s", e)
             return None
+
+    def kv_hint(self, payload):
+        """Descriptor-less adoption hint: when ``payload``'s chain head
+        appears in ANY replica's pushed summary, return a copy carrying
+        ``kv={"discover": True}`` — the engine's adopt-plan walks the
+        store index for it at admission instead of cold-prefilling.
+        Zero request-path RPCs: the summary union is a local set
+        refreshed by the routing push, and a false positive (swept or
+        evicted donation) falls through the byte-exact adoption ladder
+        to a plain re-prefill. Payloads that already carry a descriptor
+        (handoff/drain continuations) pass through untouched — the
+        descriptor is strictly richer. Works under EVERY router policy
+        (discovery is about where pages ARE, not where requests go)."""
+        if (not isinstance(payload, dict) or payload.get("kv")
+                or not payload.get("prompt_ids")):
+            return payload
+        with self._lock:
+            warm = self._kv_warm
+        if not warm:
+            return payload
+        from ray_tpu.serve.prefix_cache import affinity_key as _akey
+
+        try:
+            head = _akey(payload["prompt_ids"],
+                         self._affinity_chunk).hex()[:16]
+        except Exception as e:
+            # Unhashable payload (wrong dtype/shape): no hint.
+            logger.debug("kv hint skipped: %s", e)
+            return payload
+        if head not in warm:
+            return payload
+        out = dict(payload)
+        out["kv"] = {"discover": True}
+        return out
 
     def shed_verdict(self) -> dict | None:
         """Overload-shed gate for the ingress: a verdict dict when new
@@ -561,10 +630,19 @@ class DeploymentHandle:
 
     def method(self, method_name: str, *args, **kwargs):
         # Dict payloads with prompt_ids rendezvous-route under the
-        # affinity policy; everything else picks by load.
+        # affinity policy; everything else picks by load. The warm-
+        # discovery hint rides the same payload (kv_hint — no-op
+        # unless a pushed summary says the prefix is donated somewhere);
+        # it is computed AFTER the pick so a stale handle hints from the
+        # refreshed summary, not the pre-refresh one (stream() orders
+        # the same way).
         key = self.affinity_key(args[0]) if args else None
-        return self.dispatch(self._pick_replica(key), method_name, args,
-                             kwargs)
+        replica = self._pick_replica(key)
+        if args:
+            hinted = self.kv_hint(args[0])
+            if hinted is not args[0]:
+                args = (hinted,) + args[1:]
+        return self.dispatch(replica, method_name, args, kwargs)
 
     def stream(self, request: dict, *,
                submit_method: str = "submit_stream",
@@ -650,6 +728,9 @@ class DeploymentHandle:
                         replica = cur._pick_replica(key)
                         req = dict(request)
                         req.update(carry)
+                        # Warm-discovery hint (no-op when a handoff/
+                        # export descriptor already rides in carry).
+                        req = cur.kv_hint(req)
                         if emitted:
                             req["generated_ids"] = list(emitted)
                         sid = ray_tpu.get(

@@ -46,6 +46,15 @@ _DRAIN_DURATION = _profiling.Histogram(
     boundaries=_profiling.LATENCY_BUCKETS_S,
     tag_keys=("deployment",))
 
+# Routing-push payload (control-plane soak measurement): serialized
+# bytes of the per-replica load/summary table each get_routing build
+# ships — the number that must stay bounded as replica counts and KV
+# summaries grow (the summary rides this push; serve_kv_summary_max is
+# the per-replica cap).
+_ROUTES_PUSH_BYTES = _profiling.Counter(
+    "serve_routes_push_bytes",
+    description="Serialized load-table bytes shipped by routing pushes")
+
 # Per-replica load HISTORY (decision plane): each reconcile re-exports
 # the probe's engine load under deployment-tagged gauges, so the GCS
 # series store accumulates the rolling per-replica history the shadow
@@ -374,7 +383,7 @@ class ServeController:
         wall time so consumers can staleness-decay a lagging probe."""
         load = s.get("load") or {}
         qd = float(load.get("queue_depth", 0.0))
-        return {
+        row = {
             "queue_depth": qd,
             "ongoing": float(s.get("inflight", 0.0)) + qd,
             "ttft_ewma_ms": float(load.get("ttft_ewma_ms", 0.0)),
@@ -385,6 +394,20 @@ class ServeController:
                 load.get("spec_accepted_per_step", 0.0)),
             "ts": s.get("ts", 0.0),
         }
+        # Donated-chain summary (descriptor-less warm discovery): the
+        # replica's chain heads ride the push so handles route/hint
+        # against a LOCAL table — zero request-path index RPCs. Hard
+        # cap re-applied here (the engine bounds its own export, but
+        # the controller is the last line against an oversized row):
+        # oldest-first lists degrade to chain-head truncation keeping
+        # the newest, never an unbounded push.
+        summary = load.get("kv_summary")
+        if summary:
+            from ray_tpu.core.config import runtime_config
+
+            cap = max(1, int(runtime_config().serve_kv_summary_max))
+            row["kv_summary"] = [str(h) for h in summary[-cap:]]
+        return row
 
     def get_routing(self, known_version: int = -1) -> dict | None:
         """Routing table for handles/proxies; None if caller is up to date.
@@ -427,7 +450,18 @@ class ServeController:
                         d.get("overload_pinned")
                         and len(d["replicas"]) >= d["num_replicas"]),
                 }
-        return {"version": self.version, "ts": now, "routes": routes}
+        # Push-size measurement (the 100-replica control-plane soak
+        # number): serialized bytes of the JSON-able load/summary subset
+        # — replica handles are excluded (they don't serialize and their
+        # size is membership, not per-push payload). Counted per build
+        # AND returned in-band so benches/tests read it off the table.
+        import json as _json
+
+        push_bytes = len(_json.dumps(
+            {name: r.get("loads") or {} for name, r in routes.items()}))
+        _ROUTES_PUSH_BYTES.inc(float(push_bytes))
+        return {"version": self.version, "ts": now, "routes": routes,
+                "push_bytes": push_bytes}
 
     def request_scale_up(self, name: str) -> bool:
         """Cold-start trigger from a handle that found zero replicas (the

@@ -118,7 +118,13 @@ def engine_fingerprint(cfg, page_size: int, chunk: int,
 
 def make_meta(key_hex: str, depth: int, chunk: int, page_size: int,
               fingerprint: str, donor: str, n_pages: int,
-              draft: bool) -> dict:
+              draft: bool, tp: int = 1) -> dict:
+    # tp: the DONOR's tensor-parallel degree. tp=1 payloads are the
+    # original unsharded planes ({"k","v",...}); tp>1 payloads carry one
+    # plane per head shard ("k@0".."k@{tp-1}", partition.
+    # split_head_planes) with replicated _scale planes unsuffixed. The
+    # fingerprint stays tp-INVARIANT (full-head geometry): an adopter at
+    # any degree reassembles full heads and re-slices per its own mesh.
     return {
         "key": key_hex,
         "depth": depth,
@@ -129,6 +135,7 @@ def make_meta(key_hex: str, depth: int, chunk: int, page_size: int,
         "fingerprint": fingerprint,
         "donor": donor,
         "draft": draft,
+        "tp": int(tp),
         "ts": time.time(),
     }
 

@@ -42,6 +42,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from typing import Any, Callable
 
 import numpy as np
@@ -667,9 +668,9 @@ class LLMEngine:
                 "adoption")
         if pool_role is not None:
             kv_transfer = True
+        self._kv_transfer_disabled_reason = ""
         if kv_transfer and not (kv_mode == "paged" and prefill_chunk
-                                and prefill_chunk % page_size == 0
-                                and tp == 1):
+                                and prefill_chunk % page_size == 0):
             # chunk % page_size == 0 is load-bearing, not cosmetic:
             # page-set entries are deduped per chain DEPTH across
             # donations, and with page-aligned chunks every depth's
@@ -677,16 +678,24 @@ class LLMEngine:
             # let a chain compose depths from DIFFERENT donations whose
             # shared boundary page only one of them fully wrote —
             # adopting it would serve garbage KV for the boundary
-            # positions and silently break byte-exactness.
+            # positions and silently break byte-exactness. tp is NOT
+            # gated: tp>1 donors publish per-shard head planes and
+            # adopters reassemble/re-slice at bind time (heads are
+            # shard-invariant math — partition.split_head_planes).
+            reason = (
+                "KV page-set transfer requires kv_mode='paged' and "
+                "prefill_chunk > 0 with prefill_chunk % page_size == 0 "
+                "(cross-donation dedup needs page-aligned chain "
+                f"depths); got kv_mode={kv_mode!r}, "
+                f"prefill_chunk={prefill_chunk}, page_size={page_size}")
             if kv_explicit or pool_role is not None:
-                raise ValueError(
-                    "KV page-set transfer requires kv_mode='paged', "
-                    "prefill_chunk > 0 with prefill_chunk % page_size "
-                    "== 0 (cross-donation dedup needs page-aligned "
-                    "chain depths), and tp == 1 (payloads are "
-                    f"unsharded page planes); got kv_mode={kv_mode!r}, "
-                    f"prefill_chunk={prefill_chunk}, "
-                    f"page_size={page_size}, tp={tp}")
+                raise ValueError(reason)
+            # Observable soft-disable (same degrade contract as the
+            # llm_prefill_chunk global knob, but never silent): the
+            # reason lands in metrics()/load_snapshot() as
+            # kv_transfer_disabled_reason and is logged once here.
+            self._kv_transfer_disabled_reason = reason
+            logger.warning("llm_kv_transfer soft-disabled: %s", reason)
             kv_transfer = False
         self.pool_role = pool_role
         self.kv_transfer = bool(kv_transfer)
@@ -827,6 +836,17 @@ class LLMEngine:
                 "decode_multi_paged")
             self._rt.copy_pages = _w(
                 _mp(_paged.copy_pages_tp, mesh=self.mesh), "copy_pages")
+            # KV page-set donation/adoption at tp>1: gather reads each
+            # shard's head slice (host asarray reassembles full heads
+            # for the donor-side split), scatter re-slices a full-head
+            # adopted payload per THIS engine's mesh — the resharding
+            # half of cross-tp adoption.
+            self._rt.gather_pages = _w(
+                _mp(_paged.gather_pages_tp, mesh=self.mesh),
+                "gather_pages")
+            self._rt.scatter_pages = _w(
+                _mp(_paged.scatter_pages_tp, mesh=self.mesh),
+                "scatter_pages")
             self._rt.spec_draft_propose = _w(
                 _mp(_paged.spec_draft_propose_tp, mesh=self.mesh),
                 "spec_draft_propose")
@@ -861,6 +881,8 @@ class LLMEngine:
         # == total), rolled back in a finally so a chaos raise at
         # serve.kv.donate can't leak a reference.
         self._kv_exporting: dict[int, int] = {}
+        self._kv_donated: "OrderedDict[str, int]" = OrderedDict()
+        self._kv_summary_max = 0
         if self.kv_transfer:
             import os as _os
 
@@ -880,6 +902,19 @@ class LLMEngine:
                 cfg, page_size, prefill_chunk,
                 draft_cfg if spec_draft else None,
                 kv_dtype=self.kv_dtype)
+            from ray_tpu.core.config import runtime_config as _rc
+
+            # Donated-chain summary (descriptor-less warm discovery):
+            # chain head (16-hex prefix of the depth-1 digest — the
+            # router's affinity-key space) → deepest depth donated.
+            # Newest-last and budget-bounded (serve_kv_summary_max), it
+            # is BOTH the kv_summary exported via load_snapshot() for
+            # the controller's routing push AND the insert-on-free
+            # donation memo (a chain already donated at >= depth skips
+            # even the store resolve on repeat traffic).
+            self._kv_summary_max = max(
+                1, int(_rc().serve_kv_summary_max))
+            self._kv_donated: "OrderedDict[str, int]" = OrderedDict()
         # slot -> pinned CacheEntry while the slot is live (released on
         # free/preempt), and the tick's pending COW (src, dst) pairs,
         # flushed in one fused device copy per tick (_apply_cow).
@@ -1003,7 +1038,13 @@ class LLMEngine:
                       # pages, and ladder falls to the re-prefill rung.
                       "kv_donations": 0, "kv_donated_pages": 0,
                       "kv_adoptions": 0, "kv_partial_adoptions": 0,
-                      "kv_adopted_tokens": 0, "kv_adopt_failures": 0}
+                      "kv_adopted_tokens": 0, "kv_adopt_failures": 0,
+                      # Request-path digest index lookups (adopt-plan
+                      # resolve rounds): the descriptor-less discovery
+                      # bench pins this at 0 for un-hinted traffic —
+                      # warm discovery must ride the routing push, not
+                      # per-request GCS RPCs.
+                      "kv_digest_lookups": 0}
 
     # ------------------------------------------------------------- API
 
@@ -1492,6 +1533,15 @@ class LLMEngine:
             if self.kv_transfer:
                 m["kv_transfer"] = True
                 m["pool_role"] = self.pool_role or "fused"
+                m["kv_summary_entries"] = len(self._kv_donated)
+                m["kv_summary_max"] = self._kv_summary_max
+            elif self._kv_transfer_disabled_reason:
+                # Satellite of the soft-disable contract: the misfit
+                # that flipped the global knob off is inspectable, not
+                # just a boot-time log line.
+                m["kv_transfer"] = False
+                m["kv_transfer_disabled_reason"] = (
+                    self._kv_transfer_disabled_reason)
             if self.prefix_cache is not None:
                 m["prefix_cache"] = True
                 m["prefix_cache_entries"] = len(self.prefix_cache.entries)
@@ -1633,6 +1683,19 @@ class LLMEngine:
                     self.stats["kv_adopted_tokens"])
                 snap["kv_adopt_failures"] = (
                     self.stats["kv_adopt_failures"])
+                snap["kv_digest_lookups"] = (
+                    self.stats["kv_digest_lookups"])
+                # Donated-chain-head summary (descriptor-less warm
+                # discovery): rides the SAME zero-extra-RPC chain as
+                # the load row — Replica.stats() → controller reconcile
+                # probe → get_routing's per-replica loads → the
+                # handle's push-refreshed cache. Oldest→newest;
+                # the controller truncates keeping the newest when a
+                # replica exceeds the push cap.
+                snap["kv_summary"] = list(self._kv_donated)
+            elif self._kv_transfer_disabled_reason:
+                snap["kv_transfer_disabled_reason"] = (
+                    self._kv_transfer_disabled_reason)
             if self.prefix_cache is not None:
                 # Cached-pages + hit-rate ride the same probe chain as
                 # the rest of the load snapshot: Replica.stats() →
@@ -1748,6 +1811,29 @@ class LLMEngine:
 
     # ------------------------------------------- KV page-set transfer
 
+    def _kv_note_donation(self, head: str, depth: int) -> None:
+        """Fold a donated chain into the summary memo: head (16-hex
+        depth-1 digest prefix — the router's affinity-key space) →
+        deepest donated depth, newest-last, truncated to
+        serve_kv_summary_max so the routing push stays bounded
+        whatever this engine's donation history."""
+        m = self._kv_donated
+        m[head] = max(depth, m.get(head, 0))
+        m.move_to_end(head)
+        while len(m) > self._kv_summary_max:
+            m.popitem(last=False)
+
+    def _kv_chain_head(self, seq) -> str | None:
+        """Summary key for ``seq``'s chain: 16-hex prefix of the
+        depth-1 chunk digest (prefix_cache.affinity_key byte-identical
+        space, so pushed summaries match the handle's routing keys)."""
+        c = self.prefill_chunk
+        if not c or len(seq) < c:
+            return None
+        from ray_tpu.serve.prefix_cache import affinity_key
+
+        return affinity_key(seq, c).hex()[:16]
+
     def _donate_kv(self, seq, table_row, memo: list) -> dict | None:
         """Donate the chunk-aligned written prefix of ``seq`` (its K/V
         already sits in ``table_row``'s pages) to the page-set store as
@@ -1792,6 +1878,10 @@ class LLMEngine:
         new_depths = [d for d in range(1, n_full + 1)
                       if keys[d - 1] not in existing]
         if not new_depths:
+            # Fully deduped against prior donations — the chain is
+            # live in the store, so it still belongs in this replica's
+            # summary (and the memo spares repeat traffic the resolve).
+            self._kv_note_donation(keys[0][:16], n_full)
             return desc
         for p in pages:
             self._ref_page(p)
@@ -1806,6 +1896,12 @@ class LLMEngine:
             # Dict-generic host pull: a quantized pool's k_scale/v_scale
             # planes ride the SAME gather (every pool key is paged on
             # axis 1), so payloads carry them with no extra bookkeeping.
+            # At tp>1 the host asarray reassembles FULL-head planes from
+            # the sharded gather output; split_head_planes then cuts
+            # them back into per-shard wire planes ("k@0".."k@{tp-1}",
+            # replicated _scale planes unsuffixed) so adopters at ANY tp
+            # degree reassemble exactly the shards they need. tp=1
+            # donors keep the original unsharded payload schema.
             host = {key: np.asarray(a) for key, a in gathered.items()}
             dhost = None
             if self.spec_k:
@@ -1815,6 +1911,12 @@ class LLMEngine:
                 # planes — see _kv_adopt_plan).
                 dg = rt.gather_pages(self.draft_cache, rt.jnp.asarray(ids))
                 dhost = {key: np.asarray(a) for key, a in dg.items()}
+            if self.tp > 1:
+                from ray_tpu.models import partition as _partition
+
+                host = _partition.split_head_planes(host, self.tp)
+                if dhost is not None:
+                    dhost = _partition.split_head_planes(dhost, self.tp)
             for d in new_depths:
                 s, e = self._kvo.page_span(d, c, self.page_size)
                 payload = {key: a[:, s:e] for key, a in host.items()}
@@ -1824,11 +1926,12 @@ class LLMEngine:
                 meta = self._kvo.make_meta(
                     keys[d - 1], d, c, self.page_size,
                     self._kv_fingerprint, self._kv_donor, e - s,
-                    bool(self.spec_k))
+                    bool(self.spec_k), tp=self.tp)
                 self._kv_store.donate(meta, payload)
                 self.stats["kv_donations"] += 1
                 self.stats["kv_donated_pages"] += e - s
                 _KV_COUNTERS["donations"].inc(tags=tags)
+            self._kv_note_donation(keys[0][:16], n_full)
         except Exception as e:  # noqa: BLE001 — incl. ChaosError: the
             # donor keeps serving; already-published depths stay usable.
             logger.debug("kv donation aborted mid-chain: %s", e)
@@ -1853,9 +1956,17 @@ class LLMEngine:
         if self._kv_store is None or not req.kv:
             return None
         kv = req.kv
-        if (kv.get("fingerprint") != self._kv_fingerprint
+        if not kv.get("discover") and (
+                kv.get("fingerprint") != self._kv_fingerprint
                 or kv.get("chunk") != self.prefill_chunk
                 or kv.get("page_size") != self.page_size):
+            # A full descriptor (handoff / drain export) pre-screens on
+            # its embedded geometry. A {"discover": True} hint — the
+            # handle's push-refreshed summary saying "this chain is
+            # donated SOMEWHERE" — carries none, so it goes straight to
+            # the resolve; the per-meta checks below still validate
+            # fingerprint/chunk/page_size before anything binds (a
+            # summary false positive falls through the ladder).
             return None
         from ray_tpu.serve.prefix_cache import extend_chunk_chain
 
@@ -1866,6 +1977,7 @@ class LLMEngine:
                                    req.prefix_hashes)
         keys = [h.hex() for h in chain[:cap]]
         try:
+            self.stats["kv_digest_lookups"] += 1
             found = self._kv_store.resolve(keys)
         except Exception as e:  # noqa: BLE001 — index blip = cold path
             logger.debug("kv adoption resolve failed: %s", e)
@@ -1900,6 +2012,17 @@ class LLMEngine:
         for meta in plan["metas"]:
             try:
                 p = self._kv_store.fetch(meta)
+                donor_tp = int(meta.get("tp", 1) or 1)
+                if donor_tp > 1:
+                    # Resharding adoption: reassemble the donor's
+                    # per-shard head planes into full-head planes
+                    # (raises on a torn donation → partial rung); the
+                    # scatter below — shard_map-rebound at tp>1 —
+                    # re-slices per THIS engine's mesh, so tp=2→tp=4
+                    # and the reverse are the same two steps.
+                    from ray_tpu.models import partition as _partition
+
+                    p = _partition.concat_head_planes(p, donor_tp)
                 if (p["k"].shape[1] != meta["n_pages"]
                         or (self.spec_k and "dk" not in p)):
                     raise ValueError("kv payload shape mismatch")
@@ -2658,6 +2781,29 @@ class LLMEngine:
             self.prefix_cache.donate(seq, self.page_table[slot],
                                      memo=req.prefix_hashes)
             self._sync_cache_evictions()
+        if (self.kv_transfer and self._kv_store is not None
+                and self.pool_role is None and req is not None
+                and req.done.is_set() and req.error is None
+                and not req.migrated):
+            # Insert-on-free OBJECT donation (the fused-engine half of
+            # the init contract: "completed requests donate"): the
+            # written chunk-aligned prefix leaves as page-set objects
+            # BEFORE the slot's refs drop, so any other replica — via a
+            # pushed summary hint or an explicit descriptor — can adopt
+            # it. The summary memo gates repeat traffic: a chain this
+            # engine already donated at >= this depth skips even the
+            # store resolve (pool replicas donate on handoff/drain
+            # instead — prefill donates per-request already, decode
+            # frees adopted pages it did not produce).
+            n_written = int(self.positions[slot])
+            seq = (req.prompt_ids[:req.n_prompt]
+                   + req.out_ids)[:n_written]
+            head = self._kv_chain_head(seq)
+            if (head is not None
+                    and self._kv_donated.get(head, 0)
+                    < len(seq) // self.prefill_chunk):
+                self._donate_kv(seq, self.page_table[slot],
+                                memo=req.prefix_hashes)
         self.tokens[slot] = 0
         self.positions[slot] = 0
         self.temps[slot] = 0.0

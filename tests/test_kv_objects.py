@@ -81,9 +81,9 @@ def _prompt(seed, n):
 
 
 def _export_mid_decode(params, prompt, store, *, max_tokens=24,
-                       steps=5):
+                       steps=5, **kw):
     """Donor engine: run a stream partway, export it with KV donated."""
-    donor = _engine(params, kv_transfer=True, kv_store=store)
+    donor = _engine(params, kv_transfer=True, kv_store=store, **kw)
     req = donor.submit(prompt, max_tokens=max_tokens, stream=True)
     for _ in range(steps):
         donor.step()
@@ -602,6 +602,208 @@ class TestPreemptRegrow:
         _closure(eng)
 
 
+class TestReshardingAdoption:
+    """Sharded donation + resharding adoption (ISSUE 20 tentpole a):
+    tp>1 donors publish per-shard head planes (`k@s`/`v@s`); an adopter
+    at a DIFFERENT tp degree re-splits the concatenated heads at bind
+    time — the head axis is shard-invariant math, so the spliced stream
+    must stay byte-identical to an uninterrupted single-shard engine."""
+
+    pytestmark = pytest.mark.skipif(
+        len(jax.devices()) < 4,
+        reason="resharding tests need >= 4 (virtual) devices")
+
+    def _expected(self, params, prompt, **kw):
+        cold = _engine(params, **kw)
+        return _drive(cold, [cold.submit(prompt, max_tokens=24)])[0]
+
+    @pytest.mark.parametrize("donor_tp,adopter_tp", [(2, 4), (4, 2)])
+    @pytest.mark.parametrize("attn_impl", ["gather", "kernel"])
+    def test_reshard_byte_exact(self, params, donor_tp, adopter_tp,
+                                attn_impl):
+        prompt = _prompt(70, 50)
+        exp = self._expected(params, prompt, attn_impl=attn_impl)
+        store = LocalKVStore(budget=64)
+        _donor, cont = _export_mid_decode(
+            params, prompt, store, attn_impl=attn_impl, tp=donor_tp)
+        # The wire schema is sharded: per-depth rows carry the donor tp
+        # and suffixed head planes, never an unsharded "k".
+        metas = store.resolve(cont["kv"]["keys"])
+        assert metas and all(m["tp"] == donor_tp for m in metas.values())
+        p = store.fetch(next(iter(metas.values())))
+        assert f"k@{donor_tp - 1}" in p and "k" not in p
+        adopter, out = _resume(params, cont, store,
+                               attn_impl=attn_impl, tp=adopter_tp)
+        assert out == exp
+        m = adopter.metrics()
+        assert m["kv_adoptions"] == 1 and m["kv_adopt_failures"] == 0
+        assert m["kv_adopted_tokens"] == cont["kv"]["n_tokens"]
+
+    @pytest.mark.parametrize("donor_tp,adopter_tp", [(2, 4), (4, 2)])
+    def test_reshard_int8_scale_planes(self, params, donor_tp,
+                                       adopter_tp):
+        """int8 pool across a reshard: the quantized page planes split
+        per shard while the per-page scale planes (head-free, [L, n])
+        ride UNSUFFIXED as one replicated copy."""
+        prompt = _prompt(71, 50)
+        exp = self._expected(params, prompt, kv_dtype="int8")
+        store = LocalKVStore(budget=64)
+        _donor, cont = _export_mid_decode(
+            params, prompt, store, kv_dtype="int8", tp=donor_tp)
+        metas = store.resolve(cont["kv"]["keys"])
+        p = store.fetch(next(iter(metas.values())))
+        assert f"k@{donor_tp - 1}" in p
+        assert "k_scale" in p and "k_scale@0" not in p
+        adopter, out = _resume(params, cont, store,
+                               kv_dtype="int8", tp=adopter_tp)
+        assert out == exp
+        assert adopter.metrics()["kv_adoptions"] == 1
+
+    def test_tp_donor_to_tp1_adopter(self, params):
+        """Degenerate reshard: a tp=2 donor's sharded rows concatenate
+        back to full heads on a single-shard adopter."""
+        prompt = _prompt(73, 50)
+        exp = self._expected(params, prompt)
+        store = LocalKVStore(budget=64)
+        _donor, cont = _export_mid_decode(params, prompt, store, tp=2)
+        adopter, out = _resume(params, cont, store)
+        assert out == exp
+        assert adopter.metrics()["kv_adoptions"] == 1
+
+    def test_donor_dies_mid_sharded_donation_index_consistent(
+            self, params):
+        """The donor dies partway through a SHARDED donation (some
+        depths stored, the rest never made it): the index never holds a
+        torn row — every surviving depth fetches a COMPLETE shard set —
+        so the adopter partial-adopts the surviving prefix, re-prefills
+        the rest, and stays byte-exact at a different tp degree."""
+        prompt = _prompt(72, 60)
+        exp = self._expected(params, prompt)
+
+        class DyingDonorStore(LocalKVStore):
+            def __init__(self):
+                super().__init__(budget=64)
+                self.calls = 0   # NOT `donations`: the store counts those
+
+            def donate(self, meta, payload):
+                self.calls += 1
+                if self.calls > 2:
+                    raise RuntimeError("donor SIGKILLed mid-donation")
+                return super().donate(meta, payload)
+
+        store = DyingDonorStore()
+        donor, cont = _export_mid_decode(params, prompt, store, tp=2)
+        acc = _closure(donor)
+        assert acc["exporting"] == 0
+        keys = cont["kv"]["keys"]
+        assert len(keys) >= 3 and store.calls > 2
+        metas = store.resolve(keys)
+        assert set(metas) == set(keys[:2])
+        for meta in metas.values():
+            p = store.fetch(meta)
+            assert {f"k@{s}" for s in range(2)} <= set(p)
+        adopter, out = _resume(params, cont, store, tp=4)
+        assert out == exp
+        m = adopter.metrics()
+        assert m["kv_adoptions"] == 1 and m["kv_adopt_failures"] == 0
+        assert m["kv_adopted_tokens"] == 2 * CHUNK
+
+
+class TestWarmDiscovery:
+    """Descriptor-less adoption (ISSUE 20 tentpole b): donated chain
+    heads ride load_snapshot() as a bounded summary, and a
+    ``kv={"discover": True}`` hint — attached by the handle from the
+    PUSHED summary, zero request-path RPCs — authorizes the adopt-plan
+    to walk the store index at admission without any descriptor."""
+
+    def _head(self, prompt):
+        return chunk_hashes(prompt[:CHUNK], CHUNK)[0].hex()[:16]
+
+    def test_completion_donates_and_populates_summary(self, params):
+        """Insert-on-free: a normally-completed request's written
+        prefix lands in the index (no drain/handoff needed), and its
+        chain head shows up in the exported summary."""
+        store = LocalKVStore(budget=64)
+        donor = _engine(params, kv_transfer=True, kv_store=store)
+        prompt = _prompt(80, 50)
+        _drive(donor, [donor.submit(prompt, max_tokens=24)])
+        assert store.stats()["entries"] > 0
+        snap = donor.load_snapshot()
+        assert self._head(prompt) in snap["kv_summary"]
+        m = donor.metrics()
+        assert m["kv_summary_entries"] == len(snap["kv_summary"])
+        assert m["kv_summary_max"] > 0
+        _closure(donor)
+
+    def test_discover_hint_adopts_without_descriptor(self, params):
+        """A replica that NEVER saw the prefix adopts on the hint
+        alone: the adopt-plan derives keys from the request's own chain
+        and resolves them locally — byte-exact, one resolve round."""
+        prompt = _prompt(81, 50)
+        cold = _engine(params)
+        exp = _drive(cold, [cold.submit(prompt, max_tokens=24)])[0]
+        store = LocalKVStore(budget=64)
+        donor = _engine(params, kv_transfer=True, kv_store=store)
+        _drive(donor, [donor.submit(prompt, max_tokens=24)])
+        adopter = _engine(params, kv_transfer=True, kv_store=store)
+        req = adopter.submit(prompt, max_tokens=24,
+                             kv={"discover": True})
+        out = _drive(adopter, [req])[0]
+        assert out == exp
+        m = adopter.metrics()
+        assert m["kv_adoptions"] == 1
+        assert m["kv_digest_lookups"] == 1
+        # Keys come from the adopter's OWN prompt chain: 3 full chunks.
+        assert m["kv_adopted_tokens"] == (len(prompt) // CHUNK) * CHUNK
+        _closure(adopter)
+
+    def test_unhinted_request_never_touches_index(self, params):
+        """No hint, no descriptor → zero resolve rounds: the discovery
+        cost lives on the routing push, never the request path."""
+        prompt = _prompt(82, 50)
+        store = LocalKVStore(budget=64)
+        donor = _engine(params, kv_transfer=True, kv_store=store)
+        _drive(donor, [donor.submit(prompt, max_tokens=24)])
+        adopter = _engine(params, kv_transfer=True, kv_store=store)
+        _drive(adopter, [adopter.submit(prompt, max_tokens=24)])
+        m = adopter.metrics()
+        assert m["kv_digest_lookups"] == 0
+        assert m["kv_adoptions"] == 0
+
+    def test_discover_false_positive_falls_through(self, params):
+        """A stale summary (donation swept/evicted) hints a prefix the
+        index no longer holds: one resolve finds nothing and the ladder
+        falls to a plain re-prefill — still byte-exact."""
+        prompt = _prompt(83, 50)
+        cold = _engine(params)
+        exp = _drive(cold, [cold.submit(prompt, max_tokens=24)])[0]
+        adopter = _engine(params, kv_transfer=True,
+                          kv_store=LocalKVStore(budget=64))
+        req = adopter.submit(prompt, max_tokens=24,
+                             kv={"discover": True})
+        out = _drive(adopter, [req])[0]
+        assert out == exp
+        m = adopter.metrics()
+        assert m["kv_digest_lookups"] == 1
+        assert m["kv_adoptions"] == 0
+
+    def test_summary_bounded_newest_kept(self, params):
+        """serve_kv_summary_max bounds the export; eviction drops the
+        OLDEST head, re-donation refreshes recency and keeps the
+        deepest donated depth."""
+        eng = _engine(params, kv_transfer=True,
+                      kv_store=LocalKVStore(budget=8))
+        eng._kv_summary_max = 3
+        for i in range(5):
+            eng._kv_note_donation(f"h{i}", 1)
+        assert list(eng._kv_donated) == ["h2", "h3", "h4"]
+        eng._kv_note_donation("h2", 4)
+        eng._kv_note_donation("h2", 2)
+        assert list(eng._kv_donated) == ["h3", "h4", "h2"]
+        assert eng._kv_donated["h2"] == 4
+        assert eng.load_snapshot()["kv_summary"] == ["h3", "h4", "h2"]
+
+
 class TestKnobValidation:
     def test_kv_transfer_explicit_requires_paged_chunked(self, params):
         with pytest.raises(ValueError, match="page-set transfer"):
@@ -627,6 +829,35 @@ class TestKnobValidation:
                             _config.Config.from_env())
         eng = _engine(params, prefill_chunk=24)
         assert eng.kv_transfer is False
+
+    def test_soft_disable_reason_is_observable(self, params, monkeypatch,
+                                               caplog):
+        """Satellite (ISSUE 20): a fleet-wide llm_kv_transfer export
+        that misfits an engine must degrade OBSERVABLY — one warning at
+        construction and a kv_transfer_disabled_reason on both the
+        metrics and load_snapshot surfaces — not silently serve cold."""
+        import logging
+
+        monkeypatch.setenv("RAY_TPU_LLM_KV_TRANSFER", "1")
+        from ray_tpu.core import config as _config
+
+        monkeypatch.setattr(_config, "GLOBAL_CONFIG",
+                            _config.Config.from_env())
+        with caplog.at_level(logging.WARNING):
+            eng = _engine(params, prefill_chunk=24)
+        assert eng.kv_transfer is False
+        assert any("soft-disabled" in r.getMessage()
+                   for r in caplog.records), caplog.records
+        m = eng.metrics()
+        assert m["kv_transfer"] is False
+        assert "page-set transfer" in m["kv_transfer_disabled_reason"]
+        snap = eng.load_snapshot()
+        assert "page-set transfer" in snap["kv_transfer_disabled_reason"]
+        # An ENABLED engine exports no reason (the field is a flag).
+        on = _engine(params, kv_transfer=True,
+                     kv_store=LocalKVStore(budget=4))
+        assert "kv_transfer_disabled_reason" not in on.metrics()
+        assert "kv_transfer_disabled_reason" not in on.load_snapshot()
 
     def test_pool_role_validation(self, params):
         with pytest.raises(ValueError, match="pool_role"):
@@ -804,6 +1035,30 @@ class TestClusterPoolSplit:
             f"http://127.0.0.1:{port}/kv", data=body, timeout=300)
         out = json.loads(r.read())["result"]
         assert out["output_ids"] == exp, out
+
+    def test_summary_and_push_bytes_ride_routing_table(self, stack):
+        """Tentpole (b) on the live stack: donated chain heads reach
+        handles through the routing push itself — kv_summary in the
+        per-replica load rows, push_bytes accounted in-band — so warm
+        discovery costs the request path zero RPCs."""
+        import ray_tpu
+        from ray_tpu.serve.api import _get_controller
+
+        _ = stack   # the handoff tests above already drove donations
+        ctrl = _get_controller()
+        heads = []
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            table = ray_tpu.get(ctrl.get_routing.remote(-1), timeout=30)
+            assert table["push_bytes"] > 0
+            rows = table["routes"]["kvp"]["loads"]
+            heads = [h for row in rows.values()
+                     for h in row.get("kv_summary", ())]
+            if heads:
+                break
+            time.sleep(0.5)
+        assert heads, "no kv_summary ever rode the routing push"
+        assert all(isinstance(h, str) and len(h) == 16 for h in heads)
 
     def test_donor_sigkill_mid_donation_zero_drop(self, stack):
         """A prefill replica SIGKILLed INSIDE a donation (chaos kill at

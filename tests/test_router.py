@@ -235,6 +235,94 @@ class TestHandleRouting:
         assert h.affinity_key({"prompt_ids": []}) is None
 
 
+class TestWarmDiscoveryRouting:
+    """Pushed KV summaries (ISSUE 20): the handle hints and routes
+    against a LOCAL push-refreshed table — discovery never costs the
+    request path an RPC."""
+
+    def _head(self, ids, chunk=8):
+        return affinity_key(ids, chunk).hex()[:16]
+
+    def test_kv_hint_attaches_discover_only_when_warm(self):
+        # Deliberately NOT the affinity policy: discovery is about
+        # where pages ARE, not where requests go.
+        h = _mk_handle("p2c_load")
+        ids = list(range(16))
+        payload = {"prompt_ids": ids, "max_tokens": 4}
+        assert h.kv_hint(payload) is payload          # nothing warm yet
+        h._kv_warm = frozenset({self._head(ids)})
+        hinted = h.kv_hint(payload)
+        assert hinted is not payload
+        assert hinted["kv"] == {"discover": True}
+        assert "kv" not in payload                    # copy, no mutation
+        cold = {"prompt_ids": [9] * 16}
+        assert h.kv_hint(cold) is cold                # head not warm
+        # A payload already carrying a descriptor (handoff/drain
+        # continuation) is strictly richer: pass through untouched.
+        rich = {"prompt_ids": ids, "kv": {"keys": ["aa"]}}
+        assert h.kv_hint(rich) is rich
+        bare = [1, 2, 3]
+        assert h.kv_hint(bare) is bare                # non-dict payload
+        empty = {"no_ids": 1}
+        assert h.kv_hint(empty) is empty
+
+    def test_p2c_routes_to_pushed_summary_holder(self):
+        """The rendezvous pick never donated the chain but another
+        replica advertises it: route to the holder (its pages adopt)."""
+        h = _mk_handle("affinity", spill_ongoing=8.0)
+        reps = [_FakeReplica(bytes([i]) * 8) for i in range(4)]
+        key = affinity_key(list(range(16)), 8)
+        pref = _rendezvous(key, reps)
+        holder = next(r for r in reps if r is not pref)
+        now = time.time()
+        h._loads = {r._actor_id.hex(): {"ongoing": 0.0, "ts": now}
+                    for r in reps}
+        h._kv_summaries = {
+            holder._actor_id.hex(): frozenset({key.hex()[:16]})}
+        assert all(h._p2c(reps, key) is holder for _ in range(16))
+
+    def test_holder_override_yields_to_pref_summary_and_spill(self):
+        h = _mk_handle("affinity", spill_ongoing=8.0)
+        reps = [_FakeReplica(bytes([i]) * 8) for i in range(4)]
+        key = affinity_key(list(range(16)), 8)
+        head = key.hex()[:16]
+        pref = _rendezvous(key, reps)
+        holder = next(r for r in reps if r is not pref)
+        now = time.time()
+        h._loads = {r._actor_id.hex(): {"ongoing": 0.0, "ts": now}
+                    for r in reps}
+        # The preferred replica ITSELF advertises the chain: no
+        # override — affinity already lands on warm pages.
+        h._kv_summaries = {
+            pref._actor_id.hex(): frozenset({head}),
+            holder._actor_id.hex(): frozenset({head})}
+        assert all(h._p2c(reps, key) is pref for _ in range(16))
+        # A hot holder never beats load balancing: the override obeys
+        # the SAME spill threshold, and routing falls back to pref.
+        h._kv_summaries = {holder._actor_id.hex(): frozenset({head})}
+        h._loads[holder._actor_id.hex()]["ongoing"] = 50.0
+        assert all(h._p2c(reps, key) is pref for _ in range(16))
+
+    def test_load_row_caps_summary_keeping_newest(self):
+        """Satellite: the controller is the last line against an
+        oversized per-replica summary — it re-applies
+        serve_kv_summary_max, truncating oldest-first (newest-last
+        entries are the ones routing should chase)."""
+        from ray_tpu.core.config import runtime_config
+        from ray_tpu.serve.controller import ServeController
+
+        cap = runtime_config().serve_kv_summary_max
+        summary = [f"{i:016x}" for i in range(cap + 40)]
+        row = ServeController._load_row(
+            {"load": {"queue_depth": 1.0, "kv_summary": summary},
+             "inflight": 0, "ts": 123.0})
+        assert row["kv_summary"] == summary[-cap:]
+        assert row["queue_depth"] == 1.0 and row["ts"] == 123.0
+        # No summary → no key (rows of non-donating replicas stay lean).
+        bare = ServeController._load_row({"load": {}, "ts": 1.0})
+        assert "kv_summary" not in bare
+
+
 class TestShedVerdict:
     def _loads(self, depths, age_s=0.0):
         now = time.time() - age_s
